@@ -1,0 +1,27 @@
+"""mxnet_tpu — a TPU-native deep learning framework.
+
+Capability parity with MXNet v0.9.1 (the NNVM-era reference at
+/root/reference), re-designed TPU-first on JAX/XLA/Pallas/pjit:
+
+* ``mxnet_tpu.ndarray`` (``mx.nd``)  — imperative tensors, async via XLA dispatch
+* ``mxnet_tpu.symbol`` (``mx.sym``)  — symbolic graphs lowered to single XLA programs
+* ``mxnet_tpu.module``               — Module / BucketingModule training API
+* ``mxnet_tpu.kvstore``              — data-parallel comm via mesh collectives
+* ``mxnet_tpu.io``                   — data iterators (NDArray/MNIST/CSV/ImageRecord)
+* ``mxnet_tpu.optimizer/metric/initializer/lr_scheduler/callback``
+"""
+
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from . import context
+from .context import Context, cpu, gpu, tpu, current_context
+from . import ndarray
+from . import ndarray as nd
+from . import random
+
+__all__ = [
+    "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
+    "nd", "ndarray", "random",
+]

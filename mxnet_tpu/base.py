@@ -1,0 +1,180 @@
+"""Base utilities for the TPU-native framework.
+
+Plays the role of dmlc-core in the reference (logging, parameter
+reflection, registries, env vars — see /root/reference SURVEY §2.9) plus
+`python/mxnet/base.py` (error type, string helpers). There is no ctypes
+ABI here: the "C API" boundary of the reference (include/mxnet/c_api.h)
+is replaced by an in-process Python API over JAX; the native runtime
+pieces live in ``mxnet_tpu.lib`` (C++ via ctypes) and are optional.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "MXNetError",
+    "MXTPUError",
+    "string_types",
+    "numeric_types",
+    "get_env",
+    "attr_bool",
+    "attr_int",
+    "attr_float",
+    "attr_shape",
+    "attr_list",
+    "Registry",
+    "c_str",  # compat no-ops
+]
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (name kept for API parity with the
+    reference's ``mxnet.base.MXNetError``, c_api_error.cc)."""
+
+
+# Alias used internally.
+MXTPUError = MXNetError
+
+
+def c_str(s):  # pragma: no cover - compat shim
+    return s
+
+
+def get_env(name: str, default, dtype: Optional[type] = None):
+    """dmlc::GetEnv equivalent. Reads ``MXNET_*`` env vars (SURVEY §5.6)."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if dtype is None:
+        dtype = type(default) if default is not None else str
+    if dtype is bool:
+        return val not in ("0", "false", "False", "")
+    return dtype(val)
+
+
+# ---------------------------------------------------------------------------
+# Attribute (string) parsing — the reference passes all op params as strings
+# through the C ABI and parses with dmlc::Parameter (SURVEY §5.6).  We keep
+# the same convention so symbol JSON round-trips are identical, but parsing
+# is pure Python.
+# ---------------------------------------------------------------------------
+
+_TRUE_SET = {"true", "True", "1"}
+_FALSE_SET = {"false", "False", "0"}
+
+
+def attr_bool(v, default: bool = False) -> bool:
+    if v is None:
+        return default
+    if isinstance(v, bool):
+        return v
+    s = str(v)
+    if s in _TRUE_SET:
+        return True
+    if s in _FALSE_SET:
+        return False
+    raise ValueError(f"cannot parse bool attr {v!r}")
+
+
+def attr_int(v, default: int = 0) -> int:
+    if v is None:
+        return default
+    return int(str(v))
+
+
+def attr_float(v, default: float = 0.0) -> float:
+    if v is None:
+        return default
+    return float(str(v))
+
+
+def attr_shape(v, default=()) -> Tuple[int, ...]:
+    """Parse "(1, 2, 3)" / "[1,2]" / "1" / () into a tuple of ints."""
+    if v is None:
+        return tuple(default)
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    s = str(v).strip()
+    if s in ("()", "[]", ""):
+        return ()
+    s = s.strip("()[]")
+    if not s.strip():
+        return ()
+    return tuple(int(float(x)) for x in s.split(",") if x.strip())
+
+
+def attr_list(v, default=()) -> Tuple[str, ...]:
+    if v is None:
+        return tuple(default)
+    if isinstance(v, (tuple, list)):
+        return tuple(str(x) for x in v)
+    s = str(v).strip().strip("()[]")
+    if not s:
+        return ()
+    return tuple(x.strip().strip("'\"") for x in s.split(","))
+
+
+def attrs_to_str(attrs: Dict[str, Any]) -> Dict[str, str]:
+    """Normalise attr dict values to strings (symbol JSON format)."""
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, (tuple, list)):
+            out[k] = "(" + ", ".join(str(x) for x in v) + ")"
+        elif isinstance(v, bool):
+            out[k] = "True" if v else "False"
+        elif isinstance(v, np.dtype) or (isinstance(v, type) and issubclass(v, np.generic)):
+            out[k] = np.dtype(v).name
+        else:
+            out[k] = str(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Registry — dmlc::Registry equivalent
+# ---------------------------------------------------------------------------
+
+
+class Registry:
+    """Simple name → object registry with alias support."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._map: Dict[str, Any] = {}
+
+    def register(self, name: str, obj=None, aliases: Iterable[str] = ()):
+        def _do(o):
+            key = name.lower()
+            if key in self._map and self._map[key] is not o:
+                logging.warning("Registry %s: overriding entry %s", self.kind, name)
+            self._map[key] = o
+            for a in aliases:
+                self._map[a.lower()] = o
+            return o
+
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def find(self, name: str):
+        return self._map.get(name.lower())
+
+    def get(self, name: str):
+        obj = self.find(name)
+        if obj is None:
+            raise MXNetError(
+                f"{self.kind} {name!r} is not registered; known: {sorted(self._map)}"
+            )
+        return obj
+
+    def names(self) -> List[str]:
+        return sorted(self._map)

@@ -1,0 +1,117 @@
+"""Device context.
+
+Parity with the reference's ``python/mxnet/context.py`` and the C++
+``Context`` struct (include/mxnet/base.h).  On the TPU build, ``tpu(i)``
+maps to the i-th JAX accelerator device; ``cpu(i)`` maps to a host
+device.  ``gpu(i)`` is accepted as an alias for ``tpu(i)`` so that
+reference user scripts run unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_devices"]
+
+
+class Context:
+    """Device context: (device_type, device_id).
+
+    Usable as a ``with`` scope exactly like the reference
+    (python/mxnet/context.py:12-87).
+    """
+
+    # matches reference devtype2str {1:'cpu', 2:'gpu', 3:'cpu_pinned'} with tpu added
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
+
+    _default = threading.local()
+
+    def __init__(self, device_type, device_id: int = 0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx: Optional[Context] = None
+
+    @property
+    def device_type(self) -> str:
+        return Context.devtype2str[self.device_typeid]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __str__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __repr__ = __str__
+
+    def __enter__(self):
+        self._old_ctx = getattr(Context._default, "ctx", None)
+        Context._default.ctx = self
+        return self
+
+    def __exit__(self, *args):
+        Context._default.ctx = self._old_ctx
+        return False
+
+    # ------------------------------------------------------------------
+    # JAX device resolution
+    # ------------------------------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete jax.Device.
+
+        gpu/tpu both resolve to the accelerator backend (alias so
+        reference scripts with ``mx.gpu()`` work); falls back to CPU
+        when no accelerator is present.
+        """
+        if self.device_type in ("cpu", "cpu_pinned"):
+            devs = jax.devices("cpu")
+            return devs[self.device_id % len(devs)]
+        devs = _accelerator_devices()
+        return devs[self.device_id % len(devs)]
+
+
+def _accelerator_devices():
+    devs = jax.devices()
+    accel = [d for d in devs if d.platform != "cpu"]
+    return accel if accel else devs
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Alias for the accelerator device (TPU on this build)."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def num_devices(device_type: str = "tpu") -> int:
+    if device_type in ("cpu", "cpu_pinned"):
+        return len(jax.devices("cpu"))
+    return len(_accelerator_devices())
+
+
+def current_context() -> Context:
+    """The ambient default context (reference: context.py:81-87)."""
+    ctx = getattr(Context._default, "ctx", None)
+    if ctx is None:
+        ctx = Context("cpu", 0)
+    return ctx

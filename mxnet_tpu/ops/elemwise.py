@@ -1,0 +1,282 @@
+"""Elementwise unary/binary/scalar operators.
+
+Parity with the reference's NNVM tensor ops:
+``src/operator/tensor/elemwise_unary_op.cc`` (unary math family),
+``elemwise_binary_op.cc``, ``elemwise_binary_scalar_op*.cc``,
+``elemwise_binary_broadcast_op_{basic,extended,logic}.cc``,
+``elemwise_sum.cc`` (ElementWiseSum) and the scalar functor library
+``mshadow_op.h``.
+
+TPU note: these all lower to single fused XLA HLO elementwise ops; XLA
+fuses chains of them into matmul epilogues automatically, so there is
+nothing to hand-schedule here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import attr_float, attr_int
+from .registry import (
+    broadcast_shape_infer,
+    register,
+    same_shape_infer,
+)
+
+# ---------------------------------------------------------------------------
+# Unary math ops (elemwise_unary_op.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "_copy": lambda x: x,
+    "negative": jnp.negative,
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "fix": jnp.fix,
+    "trunc": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": lambda x: jax.scipy.special.gammaln(x),
+    "sigmoid": jax.nn.sigmoid,
+    "relu": jax.nn.relu,
+    "softsign": jax.nn.soft_sign,
+    "erf": jax.scipy.special.erf,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+_UNARY_ALIASES = {
+    "_copy": ("identity",),
+    "negative": ("_np_negative",),
+}
+
+
+def _make_unary(name, fn):
+    def compute(op_ctx, attrs, inputs, aux):
+        return [fn(inputs[0])]
+
+    register(
+        name,
+        arg_names=("data",),
+        infer_shape=same_shape_infer(1, 1),
+        aliases=_UNARY_ALIASES.get(name, ()),
+        doc=f"Elementwise {name} (reference: src/operator/tensor/elemwise_unary_op.cc)",
+    )(compute)
+
+
+for _n, _f in _UNARY.items():
+    _make_unary(_n, _f)
+
+
+@register("BlockGrad", arg_names=("data",), infer_shape=same_shape_infer(1, 1),
+          aliases=("stop_gradient",),
+          doc="Stops gradient (reference: elemwise_unary_op.cc BlockGrad)")
+def _block_grad(op_ctx, attrs, inputs, aux):
+    return [jax.lax.stop_gradient(inputs[0])]
+
+
+@register("Cast", arg_names=("data",), infer_shape=same_shape_infer(1, 1),
+          aliases=("cast",),
+          doc="Cast dtype (reference: src/operator/cast-inl.h)")
+def _cast(op_ctx, attrs, inputs, aux):
+    return [inputs[0].astype(np.dtype(attrs["dtype"]))]
+
+
+@register("clip", arg_names=("data",), infer_shape=same_shape_infer(1, 1),
+          doc="Clip values to [a_min, a_max] (reference: matrix_op.cc clip)")
+def _clip(op_ctx, attrs, inputs, aux):
+    return [jnp.clip(inputs[0], attr_float(attrs.get("a_min")), attr_float(attrs.get("a_max")))]
+
+
+# ---------------------------------------------------------------------------
+# Binary ops, same-shape (elemwise_binary_op.cc)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "elemwise_add": jnp.add,
+    "elemwise_sub": jnp.subtract,
+    "elemwise_mul": jnp.multiply,
+    "elemwise_div": jnp.divide,
+    "_power": jnp.power,
+    "_maximum": jnp.maximum,
+    "_minimum": jnp.minimum,
+    "_hypot": jnp.hypot,
+    "_equal": lambda a, b: (a == b).astype(a.dtype),
+    "_not_equal": lambda a, b: (a != b).astype(a.dtype),
+    "_greater": lambda a, b: (a > b).astype(a.dtype),
+    "_greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+    "_lesser": lambda a, b: (a < b).astype(a.dtype),
+    "_lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+}
+
+_BINARY_ALIASES = {
+    "elemwise_add": ("_plus", "_add"),
+    "elemwise_sub": ("_minus", "_sub"),
+    "elemwise_mul": ("_mul",),
+    "elemwise_div": ("_div",),
+    "_power": ("pow",),
+}
+
+
+def _make_binary(name, fn):
+    def compute(op_ctx, attrs, inputs, aux):
+        return [fn(inputs[0], inputs[1])]
+
+    register(
+        name,
+        arg_names=("lhs", "rhs"),
+        infer_shape=same_shape_infer(2, 1),
+        aliases=_BINARY_ALIASES.get(name, ()),
+        doc=f"Elementwise binary {name} (reference: elemwise_binary_op.cc)",
+    )(compute)
+
+
+for _n, _f in _BINARY.items():
+    _make_binary(_n, _f)
+
+
+# ---------------------------------------------------------------------------
+# Broadcasting binary ops (elemwise_binary_broadcast_op_*.cc)
+# ---------------------------------------------------------------------------
+
+_BROADCAST = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "broadcast_equal": lambda a, b: (a == b).astype(a.dtype),
+    "broadcast_not_equal": lambda a, b: (a != b).astype(a.dtype),
+    "broadcast_greater": lambda a, b: (a > b).astype(a.dtype),
+    "broadcast_greater_equal": lambda a, b: (a >= b).astype(a.dtype),
+    "broadcast_lesser": lambda a, b: (a < b).astype(a.dtype),
+    "broadcast_lesser_equal": lambda a, b: (a <= b).astype(a.dtype),
+    "broadcast_logical_and": lambda a, b: ((a != 0) & (b != 0)).astype(a.dtype),
+    "broadcast_logical_or": lambda a, b: ((a != 0) | (b != 0)).astype(a.dtype),
+    "broadcast_logical_xor": lambda a, b: ((a != 0) ^ (b != 0)).astype(a.dtype),
+}
+
+_BROADCAST_ALIASES = {
+    "broadcast_add": ("broadcast_plus",),
+    "broadcast_sub": ("broadcast_minus",),
+}
+
+
+def _make_broadcast(name, fn):
+    def compute(op_ctx, attrs, inputs, aux):
+        return [fn(inputs[0], inputs[1])]
+
+    register(
+        name,
+        arg_names=("lhs", "rhs"),
+        infer_shape=broadcast_shape_infer,
+        aliases=_BROADCAST_ALIASES.get(name, ()),
+        doc=f"Broadcasting {name} (reference: elemwise_binary_broadcast_op_*.cc)",
+    )(compute)
+
+
+for _n, _f in _BROADCAST.items():
+    _make_broadcast(_n, _f)
+
+
+# ---------------------------------------------------------------------------
+# Scalar ops (elemwise_binary_scalar_op*.cc) — attr 'scalar'
+# ---------------------------------------------------------------------------
+
+_SCALAR = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: x % s,
+    "_rmod_scalar": lambda x, s: s % x,
+    "_power_scalar": lambda x, s: x ** s,
+    "_rpower_scalar": lambda x, s: s ** x,
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+    "_equal_scalar": lambda x, s: (x == s).astype(x.dtype),
+    "_not_equal_scalar": lambda x, s: (x != s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+}
+
+
+def _make_scalar(name, fn):
+    def compute(op_ctx, attrs, inputs, aux):
+        s = attr_float(attrs.get("scalar", 0.0))
+        return [fn(inputs[0], s)]
+
+    register(
+        name,
+        arg_names=("data",),
+        infer_shape=same_shape_infer(1, 1),
+        doc=f"Scalar op {name} (reference: elemwise_binary_scalar_op*.cc)",
+    )(compute)
+
+
+for _n, _f in _SCALAR.items():
+    _make_scalar(_n, _f)
+
+
+# ---------------------------------------------------------------------------
+# ElementWiseSum — variadic (elemwise_sum.cc); used by grad aggregation
+# ---------------------------------------------------------------------------
+
+
+def _sum_args(attrs):
+    n = attr_int(attrs.get("num_args", 1))
+    return [f"arg{i}" for i in range(n)]
+
+
+@register("add_n", arg_names=_sum_args, aliases=("ElementWiseSum", "_sum"),
+          infer_shape=lambda attrs, s: same_shape_infer(len(s), 1)(attrs, s),
+          doc="Sum of N arrays (reference: elemwise_sum.cc; engine grad aggregation graph_executor.cc:81)")
+def _add_n(op_ctx, attrs, inputs, aux):
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = out + x
+    return [out]
+
+
+@register("_grad_add", arg_names=("lhs", "rhs"), infer_shape=same_shape_infer(2, 1),
+          doc="In-place gradient accumulation add (reference: elemwise_binary_op.cc _grad_add)")
+def _grad_add(op_ctx, attrs, inputs, aux):
+    return [inputs[0] + inputs[1]]

@@ -1,0 +1,113 @@
+"""Indexing ops: Embedding, take, batch_take, one_hot.
+
+Reference: ``src/operator/tensor/indexing_op.{cc,h}``.
+
+TPU note: Embedding is a gather; XLA lowers it natively.  The backward
+(scatter-add) comes from jax.vjp of ``jnp.take`` — no hand-written
+AddTakeGrad needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import attr_float, attr_int
+from .registry import register, get_op
+
+
+@register("Embedding", arg_names=("data", "weight"),
+          doc="Embedding lookup (reference: indexing_op.cc Embedding)")
+def _embedding(op_ctx, attrs, inputs, aux):
+    data, weight = inputs
+    idx = data.astype(jnp.int32)
+    return [jnp.take(weight, idx, axis=0)]
+
+
+def _embedding_infer(attrs, in_shapes):
+    d, w = in_shapes
+    in_dim = attr_int(attrs.get("input_dim"))
+    out_dim = attr_int(attrs.get("output_dim"))
+    if w is None:
+        w = (in_dim, out_dim)
+    if d is None:
+        return [d, w], [None], []
+    return [d, w], [tuple(d) + (w[1],)], []
+
+
+get_op("Embedding").infer_shape = _embedding_infer
+
+
+@register("take", arg_names=("a", "indices"),
+          doc="take along axis 0 (reference: indexing_op.cc take)")
+def _take(op_ctx, attrs, inputs, aux):
+    a, idx = inputs
+    axis = attr_int(attrs.get("axis", 0))
+    mode = attrs.get("mode", "clip")
+    idx = idx.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    elif mode == "wrap":
+        idx = idx % a.shape[axis]
+    return [jnp.take(a, idx, axis=axis)]
+
+
+def _take_infer(attrs, in_shapes):
+    a, idx = in_shapes
+    if a is None or idx is None:
+        return in_shapes, [None], []
+    axis = attr_int(attrs.get("axis", 0))
+    out = tuple(a[:axis]) + tuple(idx) + tuple(a[axis + 1:])
+    return in_shapes, [out], []
+
+
+get_op("take").infer_shape = _take_infer
+
+
+@register("batch_take", arg_names=("a", "indices"),
+          infer_shape=lambda attrs, s: (s, [s[1]], []),
+          doc="Per-row element pick (reference: indexing_op.cc batch_take)")
+def _batch_take(op_ctx, attrs, inputs, aux):
+    a, idx = inputs
+    return [jnp.take_along_axis(a, idx.astype(jnp.int32)[:, None], axis=1)[:, 0]]
+
+
+@register("one_hot", arg_names=("indices",),
+          doc="One-hot encode (reference: indexing_op.cc one_hot)")
+def _one_hot(op_ctx, attrs, inputs, aux):
+    idx = inputs[0].astype(jnp.int32)
+    depth = attr_int(attrs.get("depth"))
+    on = attr_float(attrs.get("on_value", 1.0))
+    off = attr_float(attrs.get("off_value", 0.0))
+    dt = np.dtype(attrs.get("dtype", "float32"))
+    oh = jax.nn.one_hot(idx, depth, dtype=dt)
+    return [(oh * (on - off) + off).astype(dt)]
+
+
+def _one_hot_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None], []
+    return in_shapes, [tuple(s) + (attr_int(attrs.get("depth")),)], []
+
+
+get_op("one_hot").infer_shape = _one_hot_infer
+
+
+@register("where", arg_names=("condition", "x", "y"),
+          doc="Elementwise select (reference: src/operator/tensor/control_flow_op.cc)")
+def _where(op_ctx, attrs, inputs, aux):
+    cond, x, y = inputs
+    if cond.ndim < x.ndim:  # row-wise condition
+        cond = cond.reshape(cond.shape + (1,) * (x.ndim - cond.ndim))
+    return [jnp.where(cond != 0, x, y)]
+
+
+def _where_infer(attrs, in_shapes):
+    c, x, y = in_shapes
+    known = x or y
+    return [c, known, known], [known], []
+
+
+get_op("where").infer_shape = _where_infer

@@ -1,0 +1,70 @@
+"""Init ops: zeros/ones/arange/full.
+
+Reference: ``src/operator/tensor/init_op.cc`` (_zeros/_ones/_arange).
+These take no tensor inputs; shape/dtype come from attrs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import attr_float, attr_int, attr_shape
+from .registry import register
+
+
+def _dtype(attrs):
+    return np.dtype(attrs.get("dtype", "float32"))
+
+
+@register("_zeros", arg_names=(),
+          infer_shape=lambda attrs, s: ([], [attr_shape(attrs.get("shape"))], []),
+          doc="Zeros of given shape (reference: init_op.cc _zeros)")
+def _zeros(op_ctx, attrs, inputs, aux):
+    return [jnp.zeros(attr_shape(attrs.get("shape")), _dtype(attrs))]
+
+
+@register("_ones", arg_names=(),
+          infer_shape=lambda attrs, s: ([], [attr_shape(attrs.get("shape"))], []),
+          doc="Ones of given shape (reference: init_op.cc _ones)")
+def _ones(op_ctx, attrs, inputs, aux):
+    return [jnp.ones(attr_shape(attrs.get("shape")), _dtype(attrs))]
+
+
+@register("_full", arg_names=(),
+          infer_shape=lambda attrs, s: ([], [attr_shape(attrs.get("shape"))], []),
+          doc="Constant fill (reference: init_op.cc _full)")
+def _full(op_ctx, attrs, inputs, aux):
+    return [jnp.full(attr_shape(attrs.get("shape")), attr_float(attrs.get("value")), _dtype(attrs))]
+
+
+def _arange_vals(attrs):
+    start = attr_float(attrs.get("start", 0))
+    stop_s = attrs.get("stop")
+    stop = None if stop_s in (None, "None", "") else attr_float(stop_s)
+    step = attr_float(attrs.get("step", 1.0))
+    repeat = attr_int(attrs.get("repeat", 1))
+    if stop is None:
+        start, stop = 0.0, start
+    return start, stop, step, repeat
+
+
+@register("_arange", arg_names=(),
+          doc="arange with repeat (reference: init_op.cc _arange)")
+def _arange(op_ctx, attrs, inputs, aux):
+    start, stop, step, repeat = _arange_vals(attrs)
+    out = jnp.arange(start, stop, step, dtype=_dtype(attrs))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return [out]
+
+
+def _arange_infer(attrs, in_shapes):
+    start, stop, step, repeat = _arange_vals(attrs)
+    n = int(max(0, np.ceil((stop - start) / step))) * repeat
+    return [], [(n,)], []
+
+
+from .registry import get_op as _get_op
+
+_get_op("_arange").infer_shape = _arange_infer

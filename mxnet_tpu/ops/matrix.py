@@ -1,0 +1,368 @@
+"""Matrix / shape-manipulation ops.
+
+Reference: ``src/operator/tensor/matrix_op.cc:22-298`` (Reshape, Flatten,
+transpose, expand_dims, slice/crop, slice_axis, flip, dot, batch_dot),
+``src/operator/concat.cc``, ``slice_channel.cc`` (SliceChannel),
+``swapaxis.cc``, ``pad.cc``.
+
+TPU note: ``dot``/``batch_dot`` are the MXU workhorses — they lower to
+plain ``lax.dot_general`` with a float32 accumulator so XLA tiles them
+onto the systolic array; bf16 inputs keep full-precision accumulation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, attr_bool, attr_int, attr_shape
+from .registry import register
+
+
+def _infer_reshape_shape(src, target, reverse=False):
+    """Full MXNet Reshape semantics incl. 0, -1, -2, -3, -4 magic values
+    (reference: matrix_op.cc ReshapeParam / InferReshapeShape)."""
+    src = list(src)
+    if reverse:
+        src = src[::-1]
+        target = list(target)[::-1]
+    out = []
+    src_idx = 0
+    i = 0
+    target = list(target)
+    while i < len(target):
+        t = target[i]
+        if t == 0:
+            out.append(src[src_idx]); src_idx += 1
+        elif t == -1:
+            out.append(-1); src_idx += 1
+        elif t == -2:
+            out.extend(src[src_idx:]); src_idx = len(src)
+        elif t == -3:
+            out.append(src[src_idx] * src[src_idx + 1]); src_idx += 2
+        elif t == -4:
+            d1, d2 = target[i + 1], target[i + 2]
+            cur = src[src_idx]; src_idx += 1
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); i += 2
+        else:
+            out.append(t)
+            if src_idx < len(src):
+                src_idx += 1
+        i += 1
+    # resolve a single -1
+    if -1 in out:
+        known = int(np.prod([d for d in out if d != -1])) or 1
+        total = int(np.prod(src)) if src else 1
+        out[out.index(-1)] = total // known
+    if reverse:
+        out = out[::-1]
+    return tuple(int(d) for d in out)
+
+
+@register("Reshape", arg_names=("data",), aliases=("reshape",),
+          doc="Reshape with 0/-1/-2/-3/-4 magic dims (reference: matrix_op.cc:22)")
+def _reshape(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    target = attr_shape(attrs.get("shape"))
+    if not target and "target_shape" in attrs:  # legacy attr
+        target = attr_shape(attrs.get("target_shape"))
+    reverse = attr_bool(attrs.get("reverse"), False)
+    return [jnp.reshape(x, _infer_reshape_shape(x.shape, target, reverse))]
+
+
+def _reshape_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None], []
+    target = attr_shape(attrs.get("shape")) or attr_shape(attrs.get("target_shape"))
+    reverse = attr_bool(attrs.get("reverse"), False)
+    return in_shapes, [_infer_reshape_shape(s, target, reverse)], []
+
+
+from .registry import get_op as _get_op
+
+_get_op("Reshape").infer_shape = _reshape_infer
+
+
+@register("Flatten", arg_names=("data",), aliases=("flatten",),
+          infer_shape=lambda attrs, s: (
+              s, [None if s[0] is None else (s[0][0], int(np.prod(s[0][1:])))], []),
+          doc="Flatten to 2D (reference: matrix_op.cc Flatten)")
+def _flatten(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    return [jnp.reshape(x, (x.shape[0], -1))]
+
+
+@register("transpose", arg_names=("data",),
+          doc="Transpose (reference: matrix_op.cc:93 transpose)")
+def _transpose(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    axes = attr_shape(attrs.get("axes"))
+    return [jnp.transpose(x, axes if axes else None)]
+
+
+def _transpose_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None], []
+    axes = attr_shape(attrs.get("axes"))
+    if not axes:
+        axes = tuple(reversed(range(len(s))))
+    return in_shapes, [tuple(s[a] for a in axes)], []
+
+
+_get_op("transpose").infer_shape = _transpose_infer
+
+
+@register("expand_dims", arg_names=("data",),
+          doc="Insert size-1 axis (reference: matrix_op.cc expand_dims)")
+def _expand_dims(op_ctx, attrs, inputs, aux):
+    return [jnp.expand_dims(inputs[0], attr_int(attrs.get("axis")))]
+
+
+def _expand_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None], []
+    ax = attr_int(attrs.get("axis"))
+    if ax < 0:
+        ax += len(s) + 1
+    return in_shapes, [tuple(s[:ax]) + (1,) + tuple(s[ax:])], []
+
+
+_get_op("expand_dims").infer_shape = _expand_infer
+
+
+@register("slice", arg_names=("data",), aliases=("crop",),
+          doc="Slice by begin/end (reference: matrix_op.cc slice/crop)")
+def _slice(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    begin = attr_shape(attrs.get("begin"))
+    end = attr_shape(attrs.get("end"))
+    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    return [x[idx]]
+
+
+def _slice_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None], []
+    begin = attr_shape(attrs.get("begin"))
+    end = attr_shape(attrs.get("end"))
+    out = list(s)
+    for i, (b, e) in enumerate(zip(begin, end)):
+        out[i] = e - b
+    return in_shapes, [tuple(out)], []
+
+
+_get_op("slice").infer_shape = _slice_infer
+
+
+@register("slice_axis", arg_names=("data",),
+          doc="Slice along one axis (reference: matrix_op.cc slice_axis)")
+def _slice_axis(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    axis = attr_int(attrs.get("axis"))
+    begin = attr_int(attrs.get("begin"))
+    e = attrs.get("end")
+    end = x.shape[axis] if e in (None, "None", "") else attr_int(e)
+    if end < 0:
+        end += x.shape[axis]
+    if begin < 0:
+        begin += x.shape[axis]
+    return [jax.lax.slice_in_dim(x, begin, end, axis=axis)]
+
+
+@register("flip", arg_names=("data",), aliases=("reverse",),
+          doc="Reverse along axes (reference: matrix_op.cc flip)")
+def _flip(op_ctx, attrs, inputs, aux):
+    axes = attr_shape(attrs.get("axis"))
+    return [jnp.flip(inputs[0], axes)]
+
+
+@register("dot", arg_names=("lhs", "rhs"),
+          doc="Matrix product on the MXU (reference: matrix_op.cc:250 dot)")
+def _dot(op_ctx, attrs, inputs, aux):
+    a, b = inputs
+    ta = attr_bool(attrs.get("transpose_a"), False)
+    tb = attr_bool(attrs.get("transpose_b"), False)
+    if ta:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if tb:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    # float32 accumulation keeps MXU matmuls exact for bf16 inputs
+    out = jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    if out.ndim == 0:  # 1-D · 1-D: reference returns shape (1,)
+        out = out.reshape((1,))
+    return [out]
+
+
+def _dot_infer(attrs, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        return in_shapes, [None], []
+    ta = attr_bool(attrs.get("transpose_a"), False)
+    tb = attr_bool(attrs.get("transpose_b"), False)
+    a2 = tuple(reversed(a)) if ta else tuple(a)
+    b2 = tuple(reversed(b)) if tb else tuple(b)
+    if len(a2) == 1 and len(b2) == 1:
+        out = (1,)
+    elif len(b2) == 1:
+        out = a2[:-1]
+    elif len(a2) == 1:
+        out = b2[1:]
+    else:
+        out = a2[:-1] + b2[1:]
+    return in_shapes, [out], []
+
+
+_get_op("dot").infer_shape = _dot_infer
+
+
+@register("batch_dot", arg_names=("lhs", "rhs"),
+          doc="Batched matmul (reference: matrix_op.cc batch_dot)")
+def _batch_dot(op_ctx, attrs, inputs, aux):
+    a, b = inputs
+    ta = attr_bool(attrs.get("transpose_a"), False)
+    tb = attr_bool(attrs.get("transpose_b"), False)
+    if ta:
+        a = jnp.swapaxes(a, -1, -2)
+    if tb:
+        b = jnp.swapaxes(b, -1, -2)
+    out = jnp.einsum("bij,bjk->bik", a, b, preferred_element_type=jnp.float32)
+    return [out.astype(a.dtype)]
+
+
+def _batch_dot_infer(attrs, in_shapes):
+    a, b = in_shapes
+    if a is None or b is None:
+        return in_shapes, [None], []
+    ta = attr_bool(attrs.get("transpose_a"), False)
+    tb = attr_bool(attrs.get("transpose_b"), False)
+    m = a[2] if ta else a[1]
+    n = b[1] if tb else b[2]
+    return in_shapes, [(a[0], m, n)], []
+
+
+_get_op("batch_dot").infer_shape = _batch_dot_infer
+
+
+# ---------------------------------------------------------------------------
+# Concat / SliceChannel / SwapAxis / Pad / repeat / tile
+# ---------------------------------------------------------------------------
+
+
+def _concat_args(attrs):
+    n = attr_int(attrs.get("num_args", 1))
+    return [f"arg{i}" for i in range(n)]
+
+
+@register("Concat", arg_names=_concat_args, aliases=("concat",),
+          doc="Concatenate along dim (reference: src/operator/concat.cc)")
+def _concat(op_ctx, attrs, inputs, aux):
+    dim = attr_int(attrs.get("dim", 1))
+    return [jnp.concatenate(inputs, axis=dim)]
+
+
+def _concat_infer(attrs, in_shapes):
+    dim = attr_int(attrs.get("dim", 1))
+    known = [s for s in in_shapes if s is not None]
+    if not known:
+        return in_shapes, [None], []
+    base = list(known[0])
+    total = 0
+    for s in in_shapes:
+        if s is None:
+            return in_shapes, [None], []
+        total += s[dim]
+    base[dim] = total
+    return in_shapes, [tuple(base)], []
+
+
+_get_op("Concat").infer_shape = _concat_infer
+
+
+@register("SliceChannel", arg_names=("data",), aliases=("slice_channel", "split"),
+          out_names=lambda attrs: [f"output{i}" for i in range(attr_int(attrs.get("num_outputs", 1)))],
+          doc="Split into num_outputs along axis (reference: src/operator/slice_channel.cc)")
+def _slice_channel(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    n = attr_int(attrs.get("num_outputs", 1))
+    axis = attr_int(attrs.get("axis", 1))
+    squeeze = attr_bool(attrs.get("squeeze_axis"), False)
+    outs = jnp.split(x, n, axis=axis)
+    if squeeze:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return list(outs)
+
+
+def _slice_channel_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    n = attr_int(attrs.get("num_outputs", 1))
+    if s is None:
+        return in_shapes, [None] * n, []
+    axis = attr_int(attrs.get("axis", 1))
+    squeeze = attr_bool(attrs.get("squeeze_axis"), False)
+    out = list(s)
+    out[axis] = s[axis] // n
+    if squeeze and out[axis] == 1:
+        out = out[:axis] + out[axis + 1:]
+    return in_shapes, [tuple(out)] * n, []
+
+
+_get_op("SliceChannel").infer_shape = _slice_channel_infer
+
+
+@register("SwapAxis", arg_names=("data",), aliases=("swapaxes",),
+          doc="Swap two axes (reference: src/operator/swapaxis.cc)")
+def _swapaxis(op_ctx, attrs, inputs, aux):
+    d1 = attr_int(attrs.get("dim1", 0))
+    d2 = attr_int(attrs.get("dim2", 0))
+    return [jnp.swapaxes(inputs[0], d1, d2)]
+
+
+def _swap_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None], []
+    d1 = attr_int(attrs.get("dim1", 0))
+    d2 = attr_int(attrs.get("dim2", 0))
+    out = list(s)
+    out[d1], out[d2] = out[d2], out[d1]
+    return in_shapes, [tuple(out)], []
+
+
+_get_op("SwapAxis").infer_shape = _swap_infer
+
+
+@register("Pad", arg_names=("data",), aliases=("pad",),
+          doc="Constant/edge/reflect padding on spatial dims (reference: src/operator/pad.cc)")
+def _pad(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    pw = attr_shape(attrs.get("pad_width"))
+    mode = attrs.get("mode", "constant")
+    cval = float(attrs.get("constant_value", 0) or 0)
+    pads = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    if mode == "constant":
+        return [jnp.pad(x, pads, constant_values=cval)]
+    return [jnp.pad(x, pads, mode=mode)]
+
+
+@register("repeat", arg_names=("data",),
+          doc="Repeat elements (reference: matrix_op.cc repeat)")
+def _repeat(op_ctx, attrs, inputs, aux):
+    reps = attr_int(attrs.get("repeats", 1))
+    ax = attrs.get("axis")
+    axis = None if ax in (None, "None", "") else attr_int(ax)
+    return [jnp.repeat(inputs[0], reps, axis=axis)]
+
+
+@register("tile", arg_names=("data",),
+          doc="Tile array (reference: matrix_op.cc tile)")
+def _tile(op_ctx, attrs, inputs, aux):
+    return [jnp.tile(inputs[0], attr_shape(attrs.get("reps")))]

@@ -1,0 +1,947 @@
+"""Neural-network layer operators.
+
+Parity with the reference's legacy layer ops (SURVEY §2.3):
+``src/operator/fully_connected-inl.h``, ``convolution-inl.h``,
+``deconvolution-inl.h``, ``batch_norm-inl.h``, ``pooling-inl.h``,
+``activation-inl.h``, ``leaky_relu-inl.h``, ``dropout-inl.h``,
+``lrn-inl.h``, ``softmax_output-inl.h``, ``softmax_activation-inl.h``,
+``regression_output-inl.h``, ``make_loss-inl.h``, ``svm_output-inl.h``,
+``instance_norm-inl.h``, ``l2_normalization-inl.h``,
+``upsampling-inl.h``, ``sequence_{last,mask,reverse}-inl.h``,
+``loss_binary_op.cc`` (softmax_cross_entropy).
+
+TPU-first notes:
+* Convolution/FullyConnected lower straight to ``lax.conv_general_dilated``
+  / ``lax.dot_general`` with float32 accumulation — the MXU path.  XLA's
+  layout assignment picks the optimal internal layout; the API stays NCHW
+  like the reference.
+* Loss heads (SoftmaxOutput, *RegressionOutput, MakeLoss, SVMOutput)
+  reproduce MXNet's "backward ignores the incoming head gradient"
+  semantics (softmax_output-inl.h Backward) with ``jax.custom_vjp``.
+* BatchNorm moving_mean/moving_var are auxiliary states (FMutateInputs
+  in the reference); the executor threads them functionally and writes
+  back donated buffers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..base import MXNetError, attr_bool, attr_float, attr_int, attr_shape
+from .registry import register, get_op
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+
+
+def _fc_args(attrs):
+    if attr_bool(attrs.get("no_bias"), False):
+        return ["data", "weight"]
+    return ["data", "weight", "bias"]
+
+
+@register("FullyConnected", arg_names=_fc_args,
+          doc="Dense layer, MXU dot_general (reference: fully_connected-inl.h)")
+def _fully_connected(op_ctx, attrs, inputs, aux):
+    no_bias = attr_bool(attrs.get("no_bias"), False)
+    flatten = attr_bool(attrs.get("flatten"), True)
+    data, weight = inputs[0], inputs[1]
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = lax.dot_general(
+        data, weight,
+        dimension_numbers=(((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if not no_bias:
+        out = out + inputs[2]
+    return [out]
+
+
+def _fc_infer(attrs, in_shapes):
+    no_bias = attr_bool(attrs.get("no_bias"), False)
+    num_hidden = attr_int(attrs.get("num_hidden"))
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    in_dim = int(np.prod(d[1:]))
+    w = (num_hidden, in_dim)
+    ins = [tuple(d), w] if no_bias else [tuple(d), w, (num_hidden,)]
+    return ins, [(d[0], num_hidden)], []
+
+
+get_op("FullyConnected").infer_shape = _fc_infer
+
+
+# ---------------------------------------------------------------------------
+# Activation family
+# ---------------------------------------------------------------------------
+
+
+@register("Activation", arg_names=("data",),
+          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          doc="relu/sigmoid/tanh/softrelu (reference: activation-inl.h)")
+def _activation(op_ctx, attrs, inputs, aux):
+    act = attrs.get("act_type", "relu")
+    x = inputs[0]
+    if act == "relu":
+        return [jax.nn.relu(x)]
+    if act == "sigmoid":
+        return [jax.nn.sigmoid(x)]
+    if act == "tanh":
+        return [jnp.tanh(x)]
+    if act == "softrelu":
+        return [jax.nn.softplus(x)]
+    if act == "softsign":
+        return [jax.nn.soft_sign(x)]
+    raise MXNetError(f"unknown act_type {act}")
+
+
+def _lrelu_args(attrs):
+    if attrs.get("act_type", "leaky") == "prelu":
+        return ["data", "gamma"]
+    return ["data"]
+
+
+@register("LeakyReLU", arg_names=_lrelu_args, needs_rng=True,
+          doc="leaky/elu/prelu/rrelu (reference: leaky_relu-inl.h)")
+def _leaky_relu(op_ctx, attrs, inputs, aux):
+    act = attrs.get("act_type", "leaky")
+    x = inputs[0]
+    slope = attr_float(attrs.get("slope", 0.25))
+    if act == "leaky":
+        return [jnp.where(x > 0, x, slope * x)]
+    if act == "elu":
+        return [jnp.where(x > 0, x, slope * jnp.expm1(x))]
+    if act == "prelu":
+        gamma = inputs[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return [jnp.where(x > 0, x, gamma * x)]
+    if act == "rrelu":
+        lo = attr_float(attrs.get("lower_bound", 0.125))
+        hi = attr_float(attrs.get("upper_bound", 0.334))
+        if op_ctx.is_train:
+            s = jax.random.uniform(op_ctx.rng, x.shape[:1] + x.shape[1:2], minval=lo, maxval=hi)
+            s = s.reshape(x.shape[:2] + (1,) * (x.ndim - 2)).astype(x.dtype)
+        else:
+            s = (lo + hi) / 2.0
+        return [jnp.where(x > 0, x, s * x)]
+    raise MXNetError(f"unknown LeakyReLU act_type {act}")
+
+
+def _lrelu_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if attrs.get("act_type", "leaky") == "prelu":
+        g = in_shapes[1] if len(in_shapes) > 1 else None
+        if g is None and d is not None:
+            g = (d[1],)
+        return [d, g], [d], []
+    return [d], [d], []
+
+
+get_op("LeakyReLU").infer_shape = _lrelu_infer
+
+
+@register("softmax", arg_names=("data",),
+          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          doc="softmax along axis (post-0.9 name; included for parity)")
+def _softmax_op(op_ctx, attrs, inputs, aux):
+    ax = attr_int(attrs.get("axis", -1), -1)
+    t = attr_float(attrs.get("temperature", 1.0)) or 1.0
+    return [jax.nn.softmax(inputs[0] / t, axis=ax)]
+
+
+@register("log_softmax", arg_names=("data",),
+          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          doc="log-softmax along axis")
+def _log_softmax_op(op_ctx, attrs, inputs, aux):
+    ax = attr_int(attrs.get("axis", -1), -1)
+    return [jax.nn.log_softmax(inputs[0], axis=ax)]
+
+
+@register("SoftmaxActivation", arg_names=("data",),
+          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          doc="softmax over channel or instance (reference: softmax_activation-inl.h)")
+def _softmax_activation(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    mode = attrs.get("mode", "instance")
+    if mode == "channel":
+        return [jax.nn.softmax(x, axis=1)]
+    return [jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)]
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+
+def _conv_args(attrs):
+    if attr_bool(attrs.get("no_bias"), False):
+        return ["data", "weight"]
+    return ["data", "weight", "bias"]
+
+
+def _spatial_attrs(attrs, nd):
+    kernel = attr_shape(attrs.get("kernel"))
+    stride = attr_shape(attrs.get("stride")) or (1,) * nd
+    dilate = attr_shape(attrs.get("dilate")) or (1,) * nd
+    pad = attr_shape(attrs.get("pad")) or (0,) * nd
+    return kernel, stride, dilate, pad
+
+
+_CONV_DIMNUMS = {
+    1: ("NCH", "OIH", "NCH"),
+    2: ("NCHW", "OIHW", "NCHW"),
+    3: ("NCDHW", "OIDHW", "NCDHW"),
+}
+
+
+@register("Convolution", arg_names=_conv_args,
+          doc="N-D convolution on the MXU (reference: convolution-inl.h:532; "
+              "replaces the im2col+GEMM / cuDNN paths with lax.conv_general_dilated)")
+def _convolution(op_ctx, attrs, inputs, aux):
+    data, weight = inputs[0], inputs[1]
+    nd = data.ndim - 2
+    kernel, stride, dilate, pad = _spatial_attrs(attrs, nd)
+    groups = attr_int(attrs.get("num_group", 1), 1)
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_CONV_DIMNUMS[nd],
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if not attr_bool(attrs.get("no_bias"), False):
+        bias = inputs[2].reshape((1, -1) + (1,) * nd)
+        out = out + bias
+    return [out]
+
+
+def _conv_out_size(insize, k, s, p, d):
+    kd = d * (k - 1) + 1
+    return (insize + 2 * p - kd) // s + 1
+
+
+def _conv_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    nd = len(d) - 2
+    kernel, stride, dilate, pad = _spatial_attrs(attrs, nd)
+    nf = attr_int(attrs.get("num_filter"))
+    groups = attr_int(attrs.get("num_group", 1), 1)
+    w = (nf, d[1] // groups) + tuple(kernel)
+    no_bias = attr_bool(attrs.get("no_bias"), False)
+    ins = [tuple(d), w] + ([] if no_bias else [(nf,)])
+    spatial = tuple(
+        _conv_out_size(d[2 + i], kernel[i], stride[i], pad[i], dilate[i]) for i in range(nd)
+    )
+    return ins, [(d[0], nf) + spatial], []
+
+
+get_op("Convolution").infer_shape = _conv_infer
+
+
+@register("Deconvolution", arg_names=_conv_args,
+          doc="Transposed convolution (reference: deconvolution-inl.h); "
+              "implemented as lhs-dilated conv_general_dilated")
+def _deconvolution(op_ctx, attrs, inputs, aux):
+    data, weight = inputs[0], inputs[1]
+    nd = data.ndim - 2
+    kernel, stride, dilate, pad = _spatial_attrs(attrs, nd)
+    adj = attr_shape(attrs.get("adj")) or (0,) * nd
+    groups = attr_int(attrs.get("num_group", 1), 1)
+    # deconv weight layout in the reference: (C_in, num_filter/group, *kernel)
+    # = gradient-of-conv; express as conv with lhs dilation + flipped kernel.
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    w = jnp.swapaxes(w, 0, 1) if groups == 1 else _group_swap(w, groups)
+    pads = []
+    for i in range(nd):
+        kd = dilate[i] * (kernel[i] - 1) + 1
+        lo = kd - 1 - pad[i]
+        hi = kd - 1 - pad[i] + adj[i]
+        pads.append((lo, hi))
+    out = lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * nd,
+        padding=pads,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=_CONV_DIMNUMS[nd],
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32,
+    ).astype(data.dtype)
+    if not attr_bool(attrs.get("no_bias"), True):
+        out = out + inputs[2].reshape((1, -1) + (1,) * nd)
+    return [out]
+
+
+def _group_swap(w, groups):
+    # (g*Cin_g, O_g, *k) -> (g*O_g, Cin_g, *k)
+    cin, og = w.shape[0], w.shape[1]
+    cg = cin // groups
+    w = w.reshape((groups, cg, og) + w.shape[2:])
+    w = jnp.swapaxes(w, 1, 2)
+    return w.reshape((groups * og, cg) + w.shape[3:])
+
+
+def _deconv_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    nd = len(d) - 2
+    kernel, stride, dilate, pad = _spatial_attrs(attrs, nd)
+    adj = attr_shape(attrs.get("adj")) or (0,) * nd
+    nf = attr_int(attrs.get("num_filter"))
+    groups = attr_int(attrs.get("num_group", 1), 1)
+    w = (d[1], nf // groups) + tuple(kernel)
+    no_bias = attr_bool(attrs.get("no_bias"), True)
+    ins = [tuple(d), w] + ([] if no_bias else [(nf,)])
+    spatial = tuple(
+        stride[i] * (d[2 + i] - 1) + (dilate[i] * (kernel[i] - 1) + 1) - 2 * pad[i] + adj[i]
+        for i in range(nd)
+    )
+    return ins, [(d[0], nf) + spatial], []
+
+
+get_op("Deconvolution").infer_shape = _deconv_infer
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+
+@register("Pooling", arg_names=("data",),
+          doc="max/avg/sum pooling with valid/full conventions "
+              "(reference: pooling-inl.h); lax.reduce_window")
+def _pooling(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    nd = x.ndim - 2
+    pool_type = attrs.get("pool_type", "max")
+    global_pool = attr_bool(attrs.get("global_pool"), False)
+    kernel, stride, _, pad = _spatial_attrs(attrs, nd)
+    if global_pool:
+        kernel = x.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    convention = attrs.get("pooling_convention", "valid")
+    pads = []
+    for i in range(nd):
+        lo = pad[i]
+        hi = pad[i]
+        if convention == "full" and not global_pool:
+            # ceil division: possibly extend the upper pad
+            insz = x.shape[2 + i] + 2 * pad[i]
+            out = -(-(insz - kernel[i]) // stride[i]) + 1
+            need = (out - 1) * stride[i] + kernel[i]
+            hi += max(0, need - insz)
+        pads.append((lo, hi))
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = [(0, 0), (0, 0)] + pads
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides, padding)
+    elif pool_type in ("avg", "sum"):
+        out = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if pool_type == "avg":
+            # reference divides by constant kernel area (mshadow pool)
+            out = out / float(np.prod(kernel))
+    else:
+        raise MXNetError(f"unknown pool_type {pool_type}")
+    return [out]
+
+
+def _pool_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    nd = len(d) - 2
+    if attr_bool(attrs.get("global_pool"), False):
+        return in_shapes, [tuple(d[:2]) + (1,) * nd], []
+    kernel, stride, _, pad = _spatial_attrs(attrs, nd)
+    convention = attrs.get("pooling_convention", "valid")
+    spatial = []
+    for i in range(nd):
+        insz = d[2 + i] + 2 * pad[i]
+        if convention == "full":
+            o = -(-(insz - kernel[i]) // stride[i]) + 1
+        else:
+            o = (insz - kernel[i]) // stride[i] + 1
+        spatial.append(o)
+    return in_shapes, [tuple(d[:2]) + tuple(spatial)], []
+
+
+get_op("Pooling").infer_shape = _pool_infer
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm (aux: moving_mean, moving_var)
+# ---------------------------------------------------------------------------
+
+
+@register("BatchNorm", arg_names=("data", "gamma", "beta"),
+          aux_names=("moving_mean", "moving_var"),
+          doc="Batch normalization with moving stats as aux states "
+              "(reference: batch_norm-inl.h:313; FMutateInputs aux semantics)")
+def _batch_norm(op_ctx, attrs, inputs, aux):
+    x, gamma, beta = inputs
+    moving_mean, moving_var = aux
+    eps = attr_float(attrs.get("eps", 1e-3), 1e-3)
+    momentum = attr_float(attrs.get("momentum", 0.9), 0.9)
+    fix_gamma = attr_bool(attrs.get("fix_gamma"), True)
+    use_global = attr_bool(attrs.get("use_global_stats"), False)
+    output_mean_var = attr_bool(attrs.get("output_mean_var"), False)
+    axes = (0,) + tuple(range(2, x.ndim))
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    if fix_gamma:
+        gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
+    if op_ctx.is_train and not use_global:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_mean = moving_mean * momentum + mean * (1 - momentum)
+        new_var = moving_var * momentum + var * (1 - momentum)
+        new_aux = [jax.lax.stop_gradient(new_mean), jax.lax.stop_gradient(new_var)]
+    else:
+        mean, var = moving_mean, moving_var
+        # inference path: constants wrt autodiff, like the reference
+        mean = jax.lax.stop_gradient(mean)
+        var = jax.lax.stop_gradient(var)
+        new_aux = [moving_mean, moving_var]
+    inv = lax.rsqrt(var + eps)
+    out = (x - mean.reshape(bshape)) * inv.reshape(bshape) * gamma.reshape(bshape) + beta.reshape(bshape)
+    outs = [out.astype(x.dtype)]
+    if output_mean_var:
+        outs += [mean, var]
+    return outs, new_aux
+
+
+def _bn_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], [None, None]
+    c = (d[1],)
+    outs = [tuple(d)]
+    if attr_bool(attrs.get("output_mean_var"), False):
+        outs += [c, c]
+    return [tuple(d), c, c], outs, [c, c]
+
+
+get_op("BatchNorm").infer_shape = _bn_infer
+
+
+def _bn_outs(attrs):
+    if attr_bool(attrs.get("output_mean_var"), False):
+        return ["output", "mean", "var"]
+    return ["output"]
+
+
+get_op("BatchNorm").out_names = _bn_outs
+
+
+@register("InstanceNorm", arg_names=("data", "gamma", "beta"),
+          doc="Instance normalization (reference: instance_norm-inl.h)")
+def _instance_norm(op_ctx, attrs, inputs, aux):
+    x, gamma, beta = inputs
+    eps = attr_float(attrs.get("eps", 1e-3), 1e-3)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    out = (x - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+    return [out]
+
+
+def _in_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    c = (d[1],)
+    return [tuple(d), c, c], [tuple(d)], []
+
+
+get_op("InstanceNorm").infer_shape = _in_infer
+
+
+@register("L2Normalization", arg_names=("data",),
+          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          doc="L2 normalization instance/channel/spatial (reference: l2_normalization-inl.h)")
+def _l2_normalization(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    eps = attr_float(attrs.get("eps", 1e-10), 1e-10)
+    mode = attrs.get("mode", "instance")
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+    else:
+        raise MXNetError(f"unknown L2Normalization mode {mode}")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return [x / norm]
+
+
+@register("LRN", arg_names=("data",),
+          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          doc="Local response norm across channels (reference: lrn-inl.h)")
+def _lrn(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    nsize = attr_int(attrs.get("nsize", 5), 5)
+    alpha = attr_float(attrs.get("alpha", 1e-4), 1e-4)
+    beta = attr_float(attrs.get("beta", 0.75), 0.75)
+    knorm = attr_float(attrs.get("knorm", 2.0), 2.0)
+    half = nsize // 2
+    sq = jnp.square(x)
+    # windowed sum over the channel axis
+    acc = lax.reduce_window(
+        sq, 0.0, lax.add,
+        window_dimensions=(1, nsize) + (1,) * (x.ndim - 2),
+        window_strides=(1,) * x.ndim,
+        padding=[(0, 0), (half, nsize - 1 - half)] + [(0, 0)] * (x.ndim - 2),
+    )
+    norm = jnp.power(knorm + (alpha / nsize) * acc, -beta)
+    return [x * norm]
+
+
+# ---------------------------------------------------------------------------
+# Dropout
+# ---------------------------------------------------------------------------
+
+
+@register("Dropout", arg_names=("data",), needs_rng=True,
+          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          doc="Inverted dropout, train-only (reference: dropout-inl.h); "
+              "JAX PRNG replaces the ResourceManager kRandom stream")
+def _dropout(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    p = attr_float(attrs.get("p", 0.5), 0.5)
+    if not op_ctx.is_train or p <= 0.0:
+        return [x]
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(op_ctx.rng, keep, x.shape)
+    return [jnp.where(mask, x / keep, 0.0).astype(x.dtype)]
+
+
+# ---------------------------------------------------------------------------
+# Loss heads with MXNet backward semantics (custom_vjp ignores cotangent)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=256)
+def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
+                       preserve_shape, normalization):
+    @jax.custom_vjp
+    def f(data, label):
+        return _softmax_fwd_only(data)
+
+    def _softmax_fwd_only(data):
+        if multi_output:
+            return jax.nn.softmax(data, axis=1)
+        if preserve_shape:
+            return jax.nn.softmax(data, axis=-1)
+        return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+    def fwd(data, label):
+        out = f(data, label)
+        return out, (out, label)
+
+    def bwd(res, g):
+        out, label = res
+        # reference semantics: backward is (softmax - onehot)*scale,
+        # independent of the incoming gradient (softmax_output-inl.h)
+        if multi_output:
+            # data (B, C, ...) label (B, ...)
+            nclass = out.shape[1]
+            lab = label.astype(jnp.int32)
+            onehot = jnp.moveaxis(jax.nn.one_hot(lab, nclass, dtype=out.dtype), -1, 1)
+            grad = out - onehot
+            valid = jnp.ones(lab.shape, out.dtype)
+            if use_ignore:
+                valid = (lab != int(ignore_label)).astype(out.dtype)
+                grad = grad * valid[:, None]
+            scale = grad_scale
+            if normalization == "batch":
+                scale = scale / out.shape[0]
+            elif normalization == "valid":
+                scale = scale / jnp.maximum(valid.sum(), 1.0)
+            grad = grad * scale
+        else:
+            if preserve_shape:
+                # softmax over last axis; label shape = data.shape[:-1]
+                flat = out.reshape(-1, out.shape[-1])
+            else:
+                flat = out.reshape(out.shape[0], -1)
+            nclass = flat.shape[1]
+            lab = label.reshape(-1).astype(jnp.int32)
+            onehot = jax.nn.one_hot(lab, nclass, dtype=out.dtype)
+            grad = flat - onehot
+            valid = jnp.ones(lab.shape, out.dtype)
+            if use_ignore:
+                valid = (lab != int(ignore_label)).astype(out.dtype)
+                grad = grad * valid[:, None]
+            scale = grad_scale
+            if normalization == "batch":
+                scale = scale / out.shape[0]
+            elif normalization == "valid":
+                scale = scale / jnp.maximum(valid.sum(), 1.0)
+            grad = (grad * scale).reshape(out.shape)
+        return grad.astype(out.dtype), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register("SoftmaxOutput", arg_names=("data", "label"), aliases=("Softmax",),
+          doc="Softmax loss head; backward = (p - onehot)*scale ignoring head "
+              "gradient (reference: softmax_output-inl.h)")
+def _softmax_output(op_ctx, attrs, inputs, aux):
+    fn = _softmax_output_fn(
+        attr_float(attrs.get("grad_scale", 1.0), 1.0),
+        attr_float(attrs.get("ignore_label", -1.0), -1.0),
+        attr_bool(attrs.get("multi_output"), False),
+        attr_bool(attrs.get("use_ignore"), False),
+        attr_bool(attrs.get("preserve_shape"), False),
+        attrs.get("normalization", "null"),
+    )
+    return [fn(inputs[0], inputs[1])]
+
+
+def _softmax_output_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    if attr_bool(attrs.get("multi_output"), False):
+        lab = (d[0],) + tuple(d[2:])
+    elif attr_bool(attrs.get("preserve_shape"), False):
+        lab = tuple(d[:-1])
+    else:
+        lab = (d[0],)
+    return [tuple(d), lab], [tuple(d)], []
+
+
+get_op("SoftmaxOutput").infer_shape = _softmax_output_infer
+
+
+def _make_regression(name, fwd_fn, grad_fn, ref):
+    @functools.lru_cache(maxsize=64)
+    def _fn(grad_scale):
+        @jax.custom_vjp
+        def f(data, label):
+            return fwd_fn(data)
+
+        def fwd(data, label):
+            out = f(data, label)
+            return out, (out, label)
+
+        def bwd(res, g):
+            out, label = res
+            # reference scales by grad_scale / num_output-per-sample
+            num_output = max(1, int(np.prod(out.shape[1:])))
+            grad = grad_fn(out, label.reshape(out.shape)) * (grad_scale / num_output)
+            return grad.astype(out.dtype), jnp.zeros_like(label)
+
+        f.defvjp(fwd, bwd)
+        return f
+
+    def compute(op_ctx, attrs, inputs, aux):
+        fn = _fn(attr_float(attrs.get("grad_scale", 1.0), 1.0))
+        return [fn(inputs[0], inputs[1])]
+
+    def infer(attrs, in_shapes):
+        d = in_shapes[0]
+        if d is None:
+            return in_shapes, [None], []
+        return [tuple(d), tuple(d)], [tuple(d)], []
+
+    register(name, arg_names=("data", "label"), infer_shape=infer,
+             doc=f"{name} (reference: {ref})")(compute)
+
+
+_make_regression("LinearRegressionOutput", lambda x: x,
+                 lambda o, l: o - l, "regression_output-inl.h linear")
+_make_regression("LogisticRegressionOutput", jax.nn.sigmoid,
+                 lambda o, l: o - l, "regression_output-inl.h logistic")
+_make_regression("MAERegressionOutput", lambda x: x,
+                 lambda o, l: jnp.sign(o - l), "regression_output-inl.h mae")
+
+
+@functools.lru_cache(maxsize=64)
+def _make_loss_fn(grad_scale, normalization, valid_thresh):
+    @jax.custom_vjp
+    def f(data):
+        return data
+
+    def fwd(data):
+        return data, data
+
+    def bwd(data, g):
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / data.shape[0]
+        elif normalization == "valid":
+            valid = (data > valid_thresh).astype(data.dtype).sum()
+            scale = scale / jnp.maximum(valid, 1.0)
+        return (jnp.full_like(data, scale),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register("MakeLoss", arg_names=("data",), aliases=("make_loss",),
+          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          doc="Treat output as loss: backward = grad_scale (reference: make_loss-inl.h)")
+def _make_loss(op_ctx, attrs, inputs, aux):
+    fn = _make_loss_fn(
+        attr_float(attrs.get("grad_scale", 1.0), 1.0),
+        attrs.get("normalization", "null"),
+        attr_float(attrs.get("valid_thresh", 0.0), 0.0),
+    )
+    return [fn(inputs[0])]
+
+
+@functools.lru_cache(maxsize=64)
+def _svm_fn(margin, reg_coef, use_linear):
+    @jax.custom_vjp
+    def f(data, label):
+        return data
+
+    def fwd(data, label):
+        return data, (data, label)
+
+    def bwd(res, g):
+        data, label = res
+        lab = label.astype(jnp.int32)
+        nclass = data.shape[1]
+        onehot = jax.nn.one_hot(lab, nclass, dtype=data.dtype)
+        y = 2 * onehot - 1  # +1 for true class, -1 otherwise
+        if use_linear:
+            # L1-SVM: grad = -y * 1[margin - y*score > 0] * reg
+            mask = ((margin - y * data) > 0).astype(data.dtype)
+            grad = -y * mask * reg_coef
+        else:
+            # L2-SVM: grad = -2 * y * max(margin - y*score, 0) * reg
+            viol = jnp.maximum(margin - y * data, 0.0)
+            grad = -2.0 * y * viol * reg_coef
+        return grad.astype(data.dtype), jnp.zeros_like(label)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register("SVMOutput", arg_names=("data", "label"),
+          doc="SVM loss head (reference: svm_output-inl.h)")
+def _svm_output(op_ctx, attrs, inputs, aux):
+    fn = _svm_fn(
+        attr_float(attrs.get("margin", 1.0), 1.0),
+        attr_float(attrs.get("regularization_coefficient", 1.0), 1.0),
+        attr_bool(attrs.get("use_linear"), False),
+    )
+    return [fn(inputs[0], inputs[1])]
+
+
+def _svm_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    return [tuple(d), (d[0],)], [tuple(d)], []
+
+
+get_op("SVMOutput").infer_shape = _svm_infer
+
+
+@register("softmax_cross_entropy", arg_names=("data", "label"),
+          infer_shape=lambda attrs, s: (s, [(1,)], []),
+          doc="Fused softmax CE loss (reference: loss_binary_op.cc)")
+def _softmax_ce(op_ctx, attrs, inputs, aux):
+    data, label = inputs
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return [jnp.sum(nll).reshape((1,))]
+
+
+# ---------------------------------------------------------------------------
+# UpSampling / Crop / sequence ops
+# ---------------------------------------------------------------------------
+
+
+def _upsampling_args(attrs):
+    n = attr_int(attrs.get("num_args", 1), 1)
+    if attrs.get("sample_type", "nearest") == "bilinear":
+        return ["data", "weight"]
+    return [f"arg{i}" for i in range(n)] if n > 1 else ["data"]
+
+
+@register("UpSampling", arg_names=_upsampling_args,
+          doc="Nearest/bilinear upsampling (reference: upsampling-inl.h); "
+              "bilinear via jax.image.resize instead of fixed deconv")
+def _upsampling(op_ctx, attrs, inputs, aux):
+    scale = attr_int(attrs.get("scale", 2), 2)
+    sample_type = attrs.get("sample_type", "nearest")
+    datas = inputs if sample_type == "nearest" else inputs[:1]
+    # reference semantics: output spatial size = first input's size * scale;
+    # every other input is nearest-upsampled by (out_size / its size)
+    oh, ow = datas[0].shape[2] * scale, datas[0].shape[3] * scale
+    outs = []
+    for x in datas:
+        if sample_type == "nearest":
+            fy, fx = oh // x.shape[2], ow // x.shape[3]
+            o = jnp.repeat(jnp.repeat(x, fy, axis=2), fx, axis=3)
+        else:
+            o = jax.image.resize(x, x.shape[:2] + (oh, ow), method="bilinear")
+        outs.append(o)
+    if len(outs) > 1:
+        return [jnp.concatenate(outs, axis=1)]
+    return outs
+
+
+def _upsampling_infer(attrs, in_shapes):
+    scale = attr_int(attrs.get("scale", 2), 2)
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    out_c = sum(s[1] for s in in_shapes if s is not None) if len(in_shapes) > 1 else d[1]
+    return in_shapes, [(d[0], out_c, d[2] * scale, d[3] * scale)], []
+
+
+get_op("UpSampling").infer_shape = _upsampling_infer
+
+
+def _crop_args(attrs):
+    n = attr_int(attrs.get("num_args", 1), 1)
+    return ["data", "crop_like"] if n == 2 else ["data"]
+
+
+@register("Crop", arg_names=_crop_args,
+          doc="Spatial crop (reference: src/operator/crop.cc)")
+def _crop_op(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    offset = attr_shape(attrs.get("offset")) or (0, 0)
+    center = attr_bool(attrs.get("center_crop"), False)
+    if len(inputs) == 2:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = attr_shape(attrs.get("h_w"))
+    if center:
+        oy = (x.shape[2] - th) // 2
+        ox = (x.shape[3] - tw) // 2
+    else:
+        oy, ox = offset
+    return [x[:, :, oy:oy + th, ox:ox + tw]]
+
+
+def _crop_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    if len(in_shapes) == 2 and in_shapes[1] is not None:
+        th, tw = in_shapes[1][2], in_shapes[1][3]
+    else:
+        hw = attr_shape(attrs.get("h_w"))
+        th, tw = hw
+    return in_shapes, [(d[0], d[1], th, tw)], []
+
+
+get_op("Crop").infer_shape = _crop_infer
+
+
+def _seq_args(attrs):
+    if attr_bool(attrs.get("use_sequence_length"), False):
+        return ["data", "sequence_length"]
+    return ["data"]
+
+
+@register("SequenceLast", arg_names=_seq_args,
+          doc="Select last valid timestep (reference: sequence_last-inl.h); data is (T,B,...)")
+def _sequence_last(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    if attr_bool(attrs.get("use_sequence_length"), False):
+        seqlen = inputs[1].astype(jnp.int32)
+        idx = jnp.clip(seqlen - 1, 0, x.shape[0] - 1)
+        return [jnp.take_along_axis(x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=0)[0]]
+    return [x[-1]]
+
+
+def _seq_last_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    ins = [tuple(d)] + ([(d[1],)] if attr_bool(attrs.get("use_sequence_length"), False) else [])
+    return ins, [tuple(d[1:])], []
+
+
+get_op("SequenceLast").infer_shape = _seq_last_infer
+
+
+@register("SequenceMask", arg_names=_seq_args,
+          doc="Zero/value-fill past sequence end (reference: sequence_mask-inl.h)")
+def _sequence_mask(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    value = attr_float(attrs.get("value", 0.0), 0.0)
+    if not attr_bool(attrs.get("use_sequence_length"), False):
+        return [x]
+    seqlen = inputs[1].astype(jnp.int32)
+    t = jnp.arange(x.shape[0])[:, None]
+    mask = t < seqlen[None, :]
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return [jnp.where(mask, x, value).astype(x.dtype)]
+
+
+def _seq_same_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    ins = [d] + ([(d[1],) if d else None] if attr_bool(attrs.get("use_sequence_length"), False) else [])
+    return ins, [d], []
+
+
+get_op("SequenceMask").infer_shape = _seq_same_infer
+
+
+@register("SequenceReverse", arg_names=_seq_args,
+          doc="Reverse valid timesteps (reference: sequence_reverse-inl.h)")
+def _sequence_reverse(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    if not attr_bool(attrs.get("use_sequence_length"), False):
+        return [jnp.flip(x, axis=0)]
+    seqlen = inputs[1].astype(jnp.int32)
+    t = jnp.arange(x.shape[0])[:, None]
+    rev_idx = jnp.where(t < seqlen[None, :], seqlen[None, :] - 1 - t, t)
+    rev_idx = jnp.broadcast_to(rev_idx.reshape(rev_idx.shape + (1,) * (x.ndim - 2)), x.shape)
+    return [jnp.take_along_axis(x, rev_idx, axis=0)]
+
+
+get_op("SequenceReverse").infer_shape = _seq_same_infer
+
+
+@register("IdentityAttachKLSparseReg", arg_names=("data",),
+          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          doc="Identity with KL sparsity regularizer gradient "
+              "(reference: identity_attach_KL_sparse_reg-inl.h)")
+def _identity_kl(op_ctx, attrs, inputs, aux):
+    # forward identity; penalty gradient added via custom vjp
+    sparseness_target = attr_float(attrs.get("sparseness_target", 0.1), 0.1)
+    penalty = attr_float(attrs.get("penalty", 0.001), 0.001)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, x
+
+    def bwd(x, g):
+        rho_hat = jnp.mean(jax.nn.sigmoid(x), axis=0, keepdims=True)
+        grad_pen = penalty * (-sparseness_target / rho_hat + (1 - sparseness_target) / (1 - rho_hat))
+        return (g + grad_pen * jnp.ones_like(x),)
+
+    f.defvjp(fwd, bwd)
+    return [f(inputs[0])]

@@ -1,0 +1,99 @@
+"""Ordering ops: topk / sort / argsort.
+
+Reference: ``src/operator/tensor/ordering_op.cc``.
+
+TPU note: lowers to XLA's sort HLO (bitonic on TPU) and
+``lax.top_k`` for the k-selection path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import attr_bool, attr_int
+from .registry import register, get_op
+
+
+def _axis(attrs, ndim, default=-1):
+    ax = attrs.get("axis", default)
+    if ax in (None, "None", ""):
+        return None
+    ax = attr_int(ax, default)
+    return ax % ndim if ax is not None else None
+
+
+@register("sort", arg_names=("data",),
+          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          doc="Sort along axis (reference: ordering_op.cc sort)")
+def _sort(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    ax = _axis(attrs, x.ndim)
+    is_ascend = attr_bool(attrs.get("is_ascend"), True)
+    out = jnp.sort(x, axis=ax)
+    if not is_ascend:
+        out = jnp.flip(out, axis=ax)
+    return [out]
+
+
+@register("argsort", arg_names=("data",),
+          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          doc="Argsort along axis (reference: ordering_op.cc argsort)")
+def _argsort(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    ax = _axis(attrs, x.ndim)
+    is_ascend = attr_bool(attrs.get("is_ascend"), True)
+    out = jnp.argsort(x, axis=ax)
+    if not is_ascend:
+        out = jnp.flip(out, axis=ax)
+    return [out.astype(jnp.float32)]
+
+
+@register("topk", arg_names=("data",),
+          doc="Top-k (reference: ordering_op.cc topk)")
+def _topk(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    ax = _axis(attrs, x.ndim)
+    k = attr_int(attrs.get("k", 1))
+    ret_typ = attrs.get("ret_typ", "indices")
+    is_ascend = attr_bool(attrs.get("is_ascend"), False)
+    moved = jnp.moveaxis(x, ax, -1)
+    vals, idxs = jax.lax.top_k(-moved if is_ascend else moved, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idxs = jnp.moveaxis(idxs, -1, ax).astype(jnp.float32)
+    if ret_typ == "value":
+        return [vals]
+    if ret_typ == "both":
+        return [vals, idxs]
+    if ret_typ == "mask":
+        onehot = jax.nn.one_hot(idxs.astype(jnp.int32), x.shape[ax], dtype=x.dtype)
+        return [jnp.moveaxis(jnp.moveaxis(onehot, ax, -2).sum(axis=-2), -1, ax)]
+    return [idxs]
+
+
+def _topk_infer(attrs, in_shapes):
+    s = in_shapes[0]
+    if s is None:
+        return in_shapes, [None], []
+    ax = _axis(attrs, len(s))
+    k = attr_int(attrs.get("k", 1))
+    ret_typ = attrs.get("ret_typ", "indices")
+    out = list(s)
+    if ret_typ != "mask":
+        out[ax] = k
+    out = tuple(out)
+    if ret_typ == "both":
+        return in_shapes, [out, out], []
+    return in_shapes, [out], []
+
+
+get_op("topk").infer_shape = _topk_infer
+
+
+def _topk_outs(attrs):
+    return ["value", "indices"] if attrs.get("ret_typ") == "both" else ["output"]
+
+
+get_op("topk").out_names = _topk_outs

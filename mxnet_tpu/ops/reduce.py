@@ -1,0 +1,117 @@
+"""Reduction and broadcast-to ops.
+
+Reference: ``src/operator/tensor/broadcast_reduce_op_{value,index}.cc``
+(sum/max/min/prod/argmax/argmin/norm, broadcast_to/broadcast_axis).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import attr_bool, attr_int, attr_shape
+from .registry import register
+
+
+def _parse_axis(attrs, ndim):
+    ax = attrs.get("axis")
+    if ax is None or str(ax) in ("", "()", "[]", "None"):
+        return None
+    axes = attr_shape(ax) if ("," in str(ax) or str(ax).startswith("(")) else (attr_int(ax),)
+    return tuple(a % ndim for a in axes)
+
+
+def _reduce_shape(in_shape, axis, keepdims):
+    if in_shape is None:
+        return None
+    nd = len(in_shape)
+    if axis is None:
+        axes = tuple(range(nd))
+    else:
+        axes = axis
+    if keepdims:
+        return tuple(1 if i in axes else s for i, s in enumerate(in_shape))
+    out = tuple(s for i, s in enumerate(in_shape) if i not in axes)
+    return out if out else (1,)
+
+
+def _make_reduce(name, fn, aliases=(), index=False):
+    def compute(op_ctx, attrs, inputs, aux):
+        x = inputs[0]
+        axis = _parse_axis(attrs, x.ndim)
+        keepdims = attr_bool(attrs.get("keepdims"), False)
+        if index:
+            ax = None if axis is None else axis[0]
+            out = fn(x, axis=ax)
+            if keepdims and ax is not None:
+                out = jnp.expand_dims(out, ax)
+            if out.ndim == 0:
+                out = out.reshape((1,))
+            return [out.astype(jnp.float32)]
+        out = fn(x, axis=axis, keepdims=keepdims)
+        if out.ndim == 0:
+            out = out.reshape((1,))
+        return [out]
+
+    def infer(attrs, in_shapes):
+        s = in_shapes[0]
+        if s is None:
+            return in_shapes, [None], []
+        axis = _parse_axis(attrs, len(s))
+        keepdims = attr_bool(attrs.get("keepdims"), False)
+        if index:
+            ax = axis  # argmax axis is single int or None
+            out = _reduce_shape(s, ax, keepdims)
+        else:
+            out = _reduce_shape(s, axis, keepdims)
+        return in_shapes, [out], []
+
+    register(name, arg_names=("data",), infer_shape=infer, aliases=aliases,
+             doc=f"Reduction {name} (reference: broadcast_reduce_op_value.cc)")(compute)
+
+
+_make_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_make_reduce("mean", jnp.mean)
+_make_reduce("prod", jnp.prod)
+_make_reduce("max", jnp.max, aliases=("max_axis",))
+_make_reduce("min", jnp.min, aliases=("min_axis",))
+_make_reduce("nansum", jnp.nansum)
+_make_reduce("nanprod", jnp.nanprod)
+_make_reduce("argmax", jnp.argmax, index=True)
+_make_reduce("argmin", jnp.argmin, index=True)
+
+
+@register("norm", arg_names=("data",),
+          infer_shape=lambda attrs, s: (s, [(1,)], []),
+          doc="L2 norm reducing to scalar (reference: broadcast_reduce_op_value.cc norm)")
+def _norm(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    return [jnp.sqrt(jnp.sum(jnp.square(x))).reshape((1,))]
+
+
+@register("argmax_channel", arg_names=("data",),
+          infer_shape=lambda attrs, s: (s, [None if s[0] is None else s[0][:1]], []),
+          doc="argmax over axis 1 (reference: broadcast_reduce_op_index.cc argmax_channel)")
+def _argmax_channel(op_ctx, attrs, inputs, aux):
+    return [jnp.argmax(inputs[0], axis=1).astype(jnp.float32)]
+
+
+@register("broadcast_to", arg_names=("data",),
+          doc="Broadcast to target shape (reference: broadcast_reduce_op_value.cc)")
+def _broadcast_to(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    target = attr_shape(attrs.get("shape"))
+    shape = tuple(x.shape[i] if t == 0 else t for i, t in enumerate(target))
+    return [jnp.broadcast_to(x, shape)]
+
+
+@register("broadcast_axis", arg_names=("data",), aliases=("broadcast_axes",),
+          doc="Broadcast along given axes (reference: broadcast_reduce_op_value.cc)")
+def _broadcast_axis(op_ctx, attrs, inputs, aux):
+    x = inputs[0]
+    axes = attr_shape(attrs.get("axis"))
+    sizes = attr_shape(attrs.get("size"))
+    shape = list(x.shape)
+    for a, s in zip(axes, sizes):
+        shape[a] = s
+    return [jnp.broadcast_to(x, tuple(shape))]

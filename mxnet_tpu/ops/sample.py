@@ -1,0 +1,67 @@
+"""Random sampling ops.
+
+Reference: ``src/operator/tensor/sample_op.cc`` (_sample_uniform,
+_sample_normal, plus gamma/exponential/poisson/negbinomial in later
+versions — uniform/normal are what v0.9.1 registers).
+
+TPU note: randomness is JAX counter-based PRNG (threefry) — the op
+receives a key through OpContext (the ResourceManager-kRandom
+equivalent, src/resource.cc:144-177).  Deterministic given seed,
+reproducible across replicas, and fully traceable under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import attr_float, attr_shape
+from .registry import register
+
+
+def _shape_dtype(attrs):
+    return attr_shape(attrs.get("shape")), np.dtype(attrs.get("dtype", "float32"))
+
+
+def _shape_infer(attrs, in_shapes):
+    return [], [attr_shape(attrs.get("shape"))], []
+
+
+@register("_sample_uniform", arg_names=(), needs_rng=True, aliases=("uniform", "_random_uniform"),
+          infer_shape=_shape_infer,
+          doc="Uniform sample in [low, high) (reference: sample_op.cc)")
+def _sample_uniform(op_ctx, attrs, inputs, aux):
+    shape, dt = _shape_dtype(attrs)
+    low = attr_float(attrs.get("low", 0.0))
+    high = attr_float(attrs.get("high", 1.0))
+    return [jax.random.uniform(op_ctx.rng, shape, dtype=jnp.float32, minval=low, maxval=high).astype(dt)]
+
+
+@register("_sample_normal", arg_names=(), needs_rng=True, aliases=("normal", "_random_normal"),
+          infer_shape=_shape_infer,
+          doc="Gaussian sample (reference: sample_op.cc)")
+def _sample_normal(op_ctx, attrs, inputs, aux):
+    shape, dt = _shape_dtype(attrs)
+    loc = attr_float(attrs.get("loc", 0.0))
+    scale = attr_float(attrs.get("scale", 1.0))
+    return [(jax.random.normal(op_ctx.rng, shape, dtype=jnp.float32) * scale + loc).astype(dt)]
+
+
+@register("_sample_gamma", arg_names=(), needs_rng=True, aliases=("_random_gamma",),
+          infer_shape=_shape_infer,
+          doc="Gamma sample (post-0.9 op, included for forward parity)")
+def _sample_gamma(op_ctx, attrs, inputs, aux):
+    shape, dt = _shape_dtype(attrs)
+    alpha = attr_float(attrs.get("alpha", 1.0))
+    beta = attr_float(attrs.get("beta", 1.0))
+    return [(jax.random.gamma(op_ctx.rng, alpha, shape, dtype=jnp.float32) * beta).astype(dt)]
+
+
+@register("_sample_exponential", arg_names=(), needs_rng=True, aliases=("_random_exponential",),
+          infer_shape=_shape_infer,
+          doc="Exponential sample")
+def _sample_exponential(op_ctx, attrs, inputs, aux):
+    shape, dt = _shape_dtype(attrs)
+    lam = attr_float(attrs.get("lam", 1.0))
+    return [(jax.random.exponential(op_ctx.rng, shape, dtype=jnp.float32) / lam).astype(dt)]

@@ -1,0 +1,51 @@
+"""Global PRNG state.
+
+Parity with ``python/mxnet/random.py`` (mx.random.seed →
+MXRandomSeed) and the per-device ResourceManager kRandom resource
+(src/resource.cc:144-177).  TPU-native: a single counter-based JAX
+threefry key split per request — deterministic given seed, safe under
+jit, identical across hosts for the same (seed, counter).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "uniform", "normal"]
+
+# process-global like the reference's MXRandomSeed (data-iterator
+# prefetch threads must see the same seeded stream)
+_lock = threading.Lock()
+_key = None
+_DEFAULT_SEED = 0
+
+
+def seed(seed_state: int):
+    """Seed all framework randomness (reference: random.py:10 mx.random.seed)."""
+    global _key
+    with _lock:
+        _key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split one key off the global stream (thread-safe)."""
+    global _key
+    with _lock:
+        if _key is None:
+            _key = jax.random.PRNGKey(_DEFAULT_SEED)
+        _key, sub = jax.random.split(_key)
+        return sub
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), ctx=None, out=None):
+    from . import ndarray as nd
+
+    return nd.uniform(low=low, high=high, shape=shape, ctx=ctx, out=out)
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), ctx=None, out=None):
+    from . import ndarray as nd
+
+    return nd.normal(loc=loc, scale=scale, shape=shape, ctx=ctx, out=out)

@@ -1,0 +1,14 @@
+"""Test configuration.
+
+Runs the whole suite on a virtual 8-device CPU mesh (the reference
+tests multi-GPU semantics on CPU the same way — SURVEY §4
+"Multi-device without a cluster").  Must set flags before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
