@@ -20,8 +20,17 @@ from .context import Context, cpu, gpu, tpu, current_context
 from . import ndarray
 from . import ndarray as nd
 from . import random
+from . import name
+from . import attribute
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym
+from .symbol import Variable, Group
+from . import executor
+from .executor import Executor
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",
-    "nd", "ndarray", "random",
+    "nd", "ndarray", "random", "name", "attribute", "AttrScope",
+    "symbol", "sym", "Variable", "Group", "executor", "Executor",
 ]

@@ -1,0 +1,53 @@
+"""Attribute scoping.
+
+Parity with ``python/mxnet/attribute.py`` — ``AttrScope`` carries
+attributes (notably ``ctx_group`` for model parallelism and
+``__force_mirroring__`` for recompute) onto symbols created inside the
+scope (SURVEY §2.4 model parallelism, §5.7 mirroring).
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope"]
+
+
+class AttrScope:
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("attributes need to be strings")
+        self._attr = kwargs
+        self._old_scope = None
+
+    def get(self, attr):
+        """Merge ambient attrs with the given explicit attrs."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        self._old_scope = getattr(AttrScope._current, "value", None)
+        attr = dict(self._old_scope._attr) if self._old_scope else {}
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, *args):
+        AttrScope._current.value = self._old_scope
+        return False
+
+    @staticmethod
+    def current() -> "AttrScope":
+        cur = getattr(AttrScope._current, "value", None)
+        if cur is None:
+            cur = AttrScope()
+            AttrScope._current.value = cur
+        return cur

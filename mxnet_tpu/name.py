@@ -1,0 +1,58 @@
+"""Automatic symbol naming.
+
+Parity with ``python/mxnet/name.py`` (NameManager / Prefix).
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class NameManager:
+    """Assigns unique names like ``convolution0`` per op type."""
+
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint: str):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = f"{hint}{self._counter[hint]}"
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        self._old_manager = getattr(NameManager._current, "value", None)
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, *args):
+        NameManager._current.value = self._old_manager
+        return False
+
+    @staticmethod
+    def current() -> "NameManager":
+        cur = getattr(NameManager._current, "value", None)
+        if cur is None:
+            cur = NameManager()
+            NameManager._current.value = cur
+        return cur
+
+
+class Prefix(NameManager):
+    """Prepends a prefix to every auto name (reference: name.py Prefix)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
