@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 ImageNet training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Baseline: the reference's strongest published single-chip number —
+ResNet-50 training, batch 32, 181.53 img/s on P100
+(docs/how_to/perf.md:131-138; see BASELINE.md).
+
+The training step is the framework's fused path: the whole
+forward+backward+SGD-update graph lowered to a single donated XLA
+program (mxnet_tpu/module/module.py _build_fused_step).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BASELINE_IMG_S = 181.53  # P100, reference perf.md:131-138
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+
+    sym = models.resnet(num_classes=1000, num_layers=50, image_shape=(3, 224, 224))
+    ctx = mx.tpu() if mx.context.num_devices() else mx.cpu()
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(batch * 2, 3, 224, 224).astype(np.float32)
+    y = rng.randint(0, 1000, size=batch * 2).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(mx.initializer.Xavier(factor_type="in", magnitude=2.34))
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01, "momentum": 0.9})
+
+    batches = list(it)
+    b0 = batches[0]
+
+    # warmup (compile)
+    for _ in range(warmup):
+        mod.forward_backward(b0)
+        mod.update()
+    mod.get_outputs()[0].wait_to_read()
+
+    t0 = time.time()
+    for i in range(iters):
+        mod.forward_backward(batches[i % len(batches)])
+        mod.update()
+    mod.get_outputs()[0].wait_to_read()
+    dt = time.time() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,18 @@ from . import symbol as sym
 from .symbol import Variable, Group
 from . import executor
 from .executor import Executor
+from . import initializer
+from .initializer import Xavier, Uniform, Normal
+from . import optimizer
+from . import metric
+from . import lr_scheduler
+from . import callback
+from . import io
+from . import kvstore
+from . import kvstore as kv
+from . import model
+from . import module
+from . import module as mod
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",

@@ -1,0 +1,41 @@
+"""Model zoo — symbol builders for the reference's example networks.
+
+Parity with ``/root/reference/example/image-classification/symbol_*.py``
+(mlp, lenet, alexnet, vgg, inception-bn, inception-v3, resnet) and
+``example/rnn``/``example/ssd`` network definitions — expressed with
+the mxnet_tpu symbolic API, TPU-friendly shapes throughout.
+"""
+
+from .mlp import get_symbol as mlp
+from .lenet import get_symbol as lenet
+from .alexnet import get_symbol as alexnet
+from .vgg import get_symbol as vgg
+from .resnet import get_symbol as resnet
+from .inception_bn import get_symbol as inception_bn
+from .inception_v3 import get_symbol as inception_v3
+
+__all__ = ["mlp", "lenet", "alexnet", "vgg", "resnet", "inception_bn",
+           "inception_v3", "get_symbol"]
+
+_FACTORY = {
+    "mlp": mlp,
+    "lenet": lenet,
+    "alexnet": alexnet,
+    "vgg": vgg,
+    "resnet": resnet,
+    "inception-bn": inception_bn,
+    "inception_bn": inception_bn,
+    "inception-v3": inception_v3,
+    "inception_v3": inception_v3,
+}
+
+
+def get_symbol(name, **kwargs):
+    """Network factory (reference: example/image-classification/train_model.py)."""
+    if name.startswith("resnet"):
+        # resnet-50 style names
+        if "-" in name and name != "resnet":
+            num_layers = int(name.split("-")[1])
+            return resnet(num_layers=num_layers, **kwargs)
+        return resnet(**kwargs)
+    return _FACTORY[name](**kwargs)

@@ -1,0 +1,458 @@
+"""Module — the primary training API.
+
+Parity with ``python/mxnet/module/module.py``: bind/init_params/
+init_optimizer/forward/backward/update/get_outputs/save_checkpoint.
+
+TPU-first: one Module = one Executor = one XLA program per
+(train/infer) phase — there is no per-device executor group.  Data
+parallelism over multiple devices is expressed with a
+``jax.sharding.Mesh`` + batch sharding on the same single program
+(see ``mxnet_tpu.kvstore`` type 'tpu' and ``mxnet_tpu.parallel``);
+XLA inserts the gradient all-reduce that the reference's
+KVStoreLocal/CommDevice performed (SURVEY §2.4).
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..initializer import Uniform
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint, save_checkpoint)
+from ..ndarray import NDArray
+from .base_module import BaseModule, _as_list
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    """reference: module.py Module"""
+
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = current_context()
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._work_load_list = work_load_list
+
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._output_names = symbol.list_outputs()
+
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+        # fused-step state (one XLA program for fwd+bwd+update; the
+        # BASELINE north-star "single HLO computation" path)
+        import os as _os
+
+        self._use_fused = _os.environ.get("MXNET_FUSED_STEP", "1") != "0"
+        self._fused_step = None
+        self._fused_state = None
+        self._pending_batch = None
+        self._step_count = 0
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """reference: module.py:83 Module.load"""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """reference: module.py:121 save_checkpoint"""
+        self._symbol.save(f"{prefix}-symbol.json")
+        param_name = "%s-%04d.params" % (prefix, epoch)
+        self.save_params(param_name)
+        logging.info('Saved checkpoint to "%s"', param_name)
+        if save_optimizer_states:
+            state_name = "%s-%04d.states" % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+            logging.info('Saved optimizer state to "%s"', state_name)
+
+    # ------------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(name, tuple(arr.shape)) for name, arr in
+                zip(self._output_names, self._exec.outputs_cache)] \
+            if self._exec.outputs_cache else self._inferred_output_shapes
+
+    def get_params(self):
+        """reference: module.py get_params"""
+        assert self.binded and self.params_initialized
+        arg_params = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux_params = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg_params, aux_params
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False):
+        """reference: module.py init_params"""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr[:] = arg_params[name]
+            elif self._arg_params is not None and name in self._arg_params:
+                arr[:] = self._arg_params[name]
+            elif allow_missing and initializer is None:
+                raise MXNetError(f"cannot init parameter {name}")
+            else:
+                if initializer is None:
+                    raise MXNetError(
+                        f"parameter {name} missing and no initializer given")
+                initializer(name, arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr[:] = aux_params[name]
+            elif self._aux_params is not None and name in self._aux_params:
+                arr[:] = self._aux_params[name]
+            elif initializer is not None:
+                initializer(name, arr)
+
+        self.params_initialized = True
+        self._params_dirty = False
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """reference: module.py:272 bind"""
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        assert not (not for_training and inputs_need_grad)
+
+        # entries are DataDesc or (name, shape) tuples — both index the same
+        self._data_shapes = [(d[0], tuple(d[1])) for d in data_shapes]
+        self._label_shapes = ([(d[0], tuple(d[1])) for d in label_shapes]
+                              if label_shapes else None)
+
+        shape_kwargs = dict(self._data_shapes)
+        if self._label_shapes:
+            shape_kwargs.update(dict(self._label_shapes))
+
+        req = {}
+        for name in self._symbol.list_arguments():
+            if name in self._data_names:
+                req[name] = "write" if inputs_need_grad else "null"
+            elif name in self._label_names:
+                req[name] = "null"
+            elif name in self._fixed_param_names or not for_training:
+                req[name] = "null"
+            else:
+                req[name] = grad_req
+
+        shared_exec = shared_module._exec if shared_module is not None else None
+        self._exec = self._symbol.simple_bind(
+            self._context[0], grad_req=req, shared_exec=shared_exec, **shape_kwargs)
+        _, out_shapes, _ = self._symbol.infer_shape(**shape_kwargs)
+        self._inferred_output_shapes = list(zip(self._output_names, out_shapes))
+        self.binded = True
+
+        # restore cached params into the fresh executor (reference:
+        # module.py bind copies _arg_params into the exec group)
+        if self.params_initialized:
+            if self._arg_params:
+                self._exec.copy_params_from(self._arg_params, self._aux_params,
+                                            allow_extra_params=True)
+
+        if shared_module is not None and shared_module.params_initialized:
+            self.set_params(*shared_module.get_params())
+
+    # ------------------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),), force_init=False):
+        """reference: module.py:357 init_optimizer"""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+
+        arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
+        (kvstore, update_on_kvstore) = _create_kvstore(
+            kvstore, len(self._context), arg_params)
+
+        if isinstance(optimizer, str):
+            batch_size = self._data_shapes[0][1][0]
+            if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+                batch_size *= kvstore.num_workers
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = 1.0 / batch_size
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name, **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt.Optimizer)
+
+        self._optimizer = optimizer
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+
+        if kvstore:
+            kvstore.set_rescale(1.0)
+            param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
+            _initialize_kvstore(kvstore=kvstore, param_arrays=param_arrays,
+                                arg_params=arg_params,
+                                param_names=self._param_names,
+                                update_on_kvstore=update_on_kvstore)
+        if update_on_kvstore:
+            kvstore.set_optimizer(self._optimizer)
+        else:
+            self._updater = opt.get_updater(optimizer)
+
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """reference: module.py forward → executor forward"""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        kwargs = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            kwargs[name] = arr
+        if self._label_names and data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                kwargs[name] = arr
+        if is_train and self._fused_ready():
+            # defer: the fused program runs in update() with this batch
+            self._pending_batch = kwargs
+            return
+        self._exec.forward(is_train=is_train, **kwargs)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        if self._pending_batch is not None:
+            if out_grads is None:
+                return  # handled by the fused step in update()
+            self._flush_pending()  # explicit head grads need the plain path
+        self._exec.backward(out_grads=out_grads)
+
+    def _flush_pending(self):
+        """Fall back to the plain executor for the deferred batch."""
+        if self._pending_batch is not None:
+            kwargs = self._pending_batch
+            self._pending_batch = None
+            self._exec.forward(is_train=True, **kwargs)
+
+    def update(self):
+        """reference: module.py:467 update → model.py:88-115"""
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        self._params_dirty = True
+        if self._pending_batch is not None:
+            self._run_fused_step()
+            return
+        param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
+        grad_arrays = [self._exec.grad_dict.get(n) for n in self._param_names]
+        if self._update_on_kvstore:
+            _update_params_on_kvstore(param_arrays, grad_arrays, self._kvstore)
+        else:
+            _update_params(param_arrays, grad_arrays, updater=self._updater,
+                           num_device=len(self._context), kvstore=self._kvstore)
+
+    # -- fused one-program training step --------------------------------
+    def _fused_ready(self):
+        return (self._use_fused and self.optimizer_initialized
+                and not self.inputs_need_grad
+                and not self._update_on_kvstore
+                and (self._kvstore is None or self._kvstore.type in ("tpu", "local", "device"))
+                and self._optimizer is not None
+                and hasattr(self._optimizer, "apply"))
+
+    def _build_fused_step(self):
+        """One donated XLA program: forward + vjp + optimizer update.
+
+        Subsumes the reference's per-node engine pushes + kvstore
+        push/pull + per-weight optimizer kernels into a single fused
+        computation — XLA overlaps backward with updates and keeps all
+        buffers on-chip (donated)."""
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        graph_fn = self._exec._graph_fn
+        pnames = list(self._grad_param_names)
+        optimizer = self._optimizer
+        lr_mult = {n: optimizer.lr_mult.get(n, 1.0) for n in pnames}
+        wd_mult = {n: optimizer.wd_mult.get(n, 1.0) for n in pnames}
+
+        def step(params, fixed, aux, states, inputs, rng, lr, t):
+            def f(p):
+                full = dict(inputs)
+                full.update(fixed)
+                full.update(p)
+                outs, new_aux = graph_fn(full, aux, rng, True)
+                return tuple(outs), new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
+            heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+            grads = vjp_fn(heads)[0]
+            new_params = {}
+            new_states = {}
+            for n in pnames:
+                w, s = optimizer.apply(params[n], grads[n], states[n],
+                                       lr * lr_mult[n],
+                                       optimizer.wd * wd_mult[n], t)
+                new_params[n] = w
+                new_states[n] = s
+            return list(outs), new_params, new_aux, new_states
+
+        return jax.jit(step, donate_argnums=(0, 3))
+
+    def _run_fused_step(self):
+        import jax.numpy as jnp
+
+        from .. import random as _random
+        from ..ndarray import NDArray
+
+        inputs = {}
+        for k, v in self._pending_batch.items():
+            arr = self._exec.arg_dict[k]
+            if isinstance(v, NDArray):
+                arr._set_data(v._data.astype(arr.dtype))
+            else:
+                arr[:] = v
+            inputs[k] = arr._data
+        self._pending_batch = None
+
+        if self._fused_step is None:
+            self._grad_param_names = [n for n in self._param_names
+                                      if self._exec.grad_req.get(n, "null") != "null"]
+            self._fused_step = self._build_fused_step()
+            self._fused_state = {
+                n: self._optimizer.init_state_arrays(self._exec.arg_dict[n]._data)
+                for n in self._grad_param_names}
+
+        params = {n: self._exec.arg_dict[n]._data for n in self._grad_param_names}
+        fixed = {n: self._exec.arg_dict[n]._data for n in self._param_names
+                 if n not in self._grad_param_names}
+        aux = {n: a._data for n, a in self._exec.aux_dict.items()}
+        self._step_count += 1
+        self._optimizer._update_count(0)
+        # base lr; per-param lr_mult/wd_mult are folded inside the step
+        lr = (self._optimizer.lr_scheduler(self._optimizer.num_update)
+              if self._optimizer.lr_scheduler else self._optimizer.lr)
+        rng = _random.next_key()
+        outs, new_params, new_aux, new_states = self._fused_step(
+            params, fixed, aux, self._fused_state, inputs, rng,
+            jnp.float32(lr), jnp.float32(self._step_count))
+        for n, v in new_params.items():
+            self._exec.arg_dict[n]._set_data(v)
+        for n, v in new_aux.items():
+            self._exec.aux_dict[n]._set_data(v)
+        self._fused_state = new_states
+        self._exec.outputs_cache = [NDArray(o, self._context[0]) for o in outs]
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        if self._pending_batch is not None:
+            # outputs requested before update(): run the plain forward so
+            # the deferred-batch optimization stays invisible to callers
+            kwargs = self._pending_batch
+            self._exec.forward(is_train=True, **kwargs)
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and self.inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    # ------------------------------------------------------------------
+    def save_optimizer_states(self, fname):
+        """reference: module.py:543 save_optimizer_states"""
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())

@@ -1,0 +1,204 @@
+"""Shared test utilities.
+
+Parity with ``python/mxnet/test_utils.py`` (789 LoC):
+``default_context``, ``reldiff``/``assert_allclose`` helpers,
+``check_numeric_gradient`` (finite differences),
+``check_consistency`` (same symbol on several contexts/dtypes),
+``simple_forward``, random seed helpers.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .context import Context, cpu, current_context
+from .ndarray import NDArray
+
+__all__ = [
+    "default_context", "default_dtype", "rand_ndarray", "reldiff",
+    "same", "assert_almost_equal", "almost_equal",
+    "check_numeric_gradient", "check_symbolic_forward",
+    "check_symbolic_backward", "check_consistency", "simple_forward",
+]
+
+
+def default_context() -> Context:
+    """Context switched by env var MXNET_TEST_DEVICE (reference behavior)."""
+    dev = os.environ.get("MXNET_TEST_DEVICE", None)
+    if dev:
+        return Context(dev)
+    return current_context()
+
+
+def default_dtype():
+    return np.float32
+
+
+def rand_ndarray(shape, ctx=None) -> NDArray:
+    return nd.array(np.random.uniform(-1.0, 1.0, shape).astype(np.float32), ctx=ctx)
+
+
+def reldiff(a, b) -> float:
+    """reference: test_utils.py reldiff"""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    diff = np.sum(np.abs(a - b))
+    norm = np.sum(np.abs(a)) + np.sum(np.abs(b))
+    if diff == 0:
+        return 0
+    return diff / norm
+
+
+def same(a, b) -> bool:
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20) -> bool:
+    return np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8, names=("a", "b")):
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if not np.allclose(a, b, rtol=rtol, atol=atol):
+        idx = np.unravel_index(np.argmax(np.abs(a - b)), a.shape) if a.shape else ()
+        raise AssertionError(
+            f"{names[0]} and {names[1]} differ: max abs err "
+            f"{np.max(np.abs(a - b)):.3e} at {idx}; rel {reldiff(a, b):.3e}")
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    """Run symbol forward on numpy inputs → numpy outputs (reference:
+    test_utils.py simple_forward)."""
+    ctx = ctx or default_context()
+    inputs = {k: np.asarray(v, np.float32) for k, v in inputs.items()}
+    args = {k: nd.array(v, ctx=ctx) for k, v in inputs.items()}
+    ex = sym.bind(ctx, args, grad_req="null")
+    outs = [o.asnumpy() for o in ex.forward(is_train=is_train)]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def _parse_location(sym, location, ctx):
+    if isinstance(location, dict):
+        return {k: (v if isinstance(v, NDArray) else nd.array(v, ctx=ctx))
+                for k, v in location.items()}
+    return {k: (v if isinstance(v, NDArray) else nd.array(v, ctx=ctx))
+            for k, v in zip(sym.list_arguments(), location)}
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None, use_forward_train=True,
+                           ctx=None):
+    """Finite-difference gradient check (reference: test_utils.py
+    check_numeric_gradient).  Sums outputs to a scalar objective."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    aux = {k: (v if isinstance(v, NDArray) else nd.array(v, ctx=ctx))
+           for k, v in (aux_states or {}).items()}
+    if grad_nodes is None:
+        grad_nodes = [k for k in sym.list_arguments() if k in location]
+
+    grads = {k: nd.zeros(location[k].shape, ctx=ctx) for k in grad_nodes}
+    req = {k: ("write" if k in grad_nodes else "null") for k in sym.list_arguments()}
+    ex = sym.bind(ctx, location, args_grad=grads, grad_req=req,
+                  aux_states=aux or None)
+    outs = ex.forward(is_train=True)
+    head_grads = [nd.ones(o.shape, ctx=ctx) for o in outs]
+    ex.backward(head_grads)
+    analytic = {k: grads[k].asnumpy().copy() for k in grad_nodes}
+
+    def objective():
+        o = ex.forward(is_train=use_forward_train)
+        return sum(float(x.asnumpy().sum()) for x in o)
+
+    for name in grad_nodes:
+        arr = location[name].asnumpy().copy()
+        num_grad = np.zeros_like(arr)
+        it = np.nditer(arr, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            orig = arr[idx]
+            arr[idx] = orig + numeric_eps
+            location[name][:] = arr
+            fp = objective()
+            arr[idx] = orig - numeric_eps
+            location[name][:] = arr
+            fm = objective()
+            arr[idx] = orig
+            num_grad[idx] = (fp - fm) / (2 * numeric_eps)
+            it.iternext()
+        location[name][:] = arr
+        rel = reldiff(analytic[name], num_grad)
+        if rel > rtol:
+            raise AssertionError(
+                f"numeric gradient check failed for {name}: reldiff={rel:.4e}\n"
+                f"analytic={analytic[name].ravel()[:8]}\nnumeric={num_grad.ravel()[:8]}")
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=1e-8,
+                           aux_states=None, ctx=None):
+    """reference: test_utils.py check_symbolic_forward"""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    aux = {k: nd.array(np.asarray(v), ctx=ctx) for k, v in (aux_states or {}).items()}
+    ex = sym.bind(ctx, location, grad_req="null", aux_states=aux or None)
+    outs = ex.forward()
+    if isinstance(expected, (list, tuple)):
+        for o, e in zip(outs, expected):
+            assert_almost_equal(o.asnumpy(), e, rtol, atol)
+    else:
+        assert_almost_equal(outs[0].asnumpy(), expected, rtol, atol)
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=1e-8, aux_states=None, grad_req="write", ctx=None):
+    """reference: test_utils.py check_symbolic_backward"""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    aux = {k: nd.array(np.asarray(v), ctx=ctx) for k, v in (aux_states or {}).items()}
+    args_grad = {k: nd.zeros(v.shape, ctx=ctx) for k, v in location.items()}
+    ex = sym.bind(ctx, location, args_grad=args_grad, grad_req=grad_req,
+                  aux_states=aux or None)
+    ex.forward(is_train=True)
+    ex.backward([g if isinstance(g, NDArray) else nd.array(np.asarray(g), ctx=ctx)
+                 for g in out_grads])
+    expected = expected if isinstance(expected, dict) else dict(
+        zip(sym.list_arguments(), expected))
+    for name, e in expected.items():
+        assert_almost_equal(args_grad[name].asnumpy(), e, rtol, atol,
+                            names=(f"grad({name})", "expected"))
+
+
+def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-4, atol=1e-5):
+    """Run same symbol in several contexts and compare all outputs/grads
+    (reference: test_utils.py check_consistency — the CPU↔GPU parity
+    driver, here CPU↔TPU)."""
+    if len(ctx_list) < 2:
+        return
+    shapes = ctx_list[0].get("ctx") and None
+    results = []
+    for spec in ctx_list:
+        ctx = spec["ctx"]
+        shape_kwargs = {k: v for k, v in spec.items() if k != "ctx" and k != "type_dict"}
+        ex = sym.simple_bind(ctx, grad_req="write", **shape_kwargs)
+        np.random.seed(0)
+        for name, arr in ex.arg_dict.items():
+            arr[:] = np.random.normal(0, scale, arr.shape)
+        outs = ex.forward(is_train=True)
+        ex.backward()
+        results.append((
+            [o.asnumpy() for o in outs],
+            {k: v.asnumpy() for k, v in ex.grad_dict.items()},
+        ))
+    ref_outs, ref_grads = results[0]
+    for outs, grads in results[1:]:
+        for o, r in zip(outs, ref_outs):
+            assert_almost_equal(o, r, rtol, atol)
+        for k in ref_grads:
+            assert_almost_equal(grads[k], ref_grads[k], rtol, atol,
+                                names=(f"grad({k})", "ref"))

@@ -3,12 +3,27 @@
 Runs the whole suite on a virtual 8-device CPU mesh (the reference
 tests multi-GPU semantics on CPU the same way — SURVEY §4
 "Multi-device without a cluster").  Must set flags before jax import.
+
+Note: the environment ships with JAX_PLATFORMS=axon (the TPU tunnel),
+so this must *override*, not setdefault — finite-difference gradient
+tests need CPU float32 matmul precision, and the suite must not
+monopolize the real chip.  Set MXNET_TEST_TPU=1 to run the suite on
+the TPU instead.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if os.environ.get("MXNET_TEST_TPU", "0") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# pytest plugins (hypothesis) import jax before this file runs; backends
+# initialize lazily, so pushing the config through jax.config still works.
+if "jax" in sys.modules and os.environ.get("MXNET_TEST_TPU", "0") != "1":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,108 @@
+"""Executor tests (modeled on tests/python/unittest/test_executor.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_bind_simple():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a + b
+    av = np.random.rand(3, 4).astype(np.float32)
+    bv = np.random.rand(3, 4).astype(np.float32)
+    ga = mx.nd.zeros((3, 4))
+    gb = mx.nd.zeros((3, 4))
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array(av), "b": mx.nd.array(bv)},
+                args_grad={"a": ga, "b": gb})
+    out = ex.forward(is_train=True)
+    assert_almost_equal(out[0].asnumpy(), av + bv)
+    head = np.random.rand(3, 4).astype(np.float32)
+    ex.backward([mx.nd.array(head)])
+    assert_almost_equal(ga.asnumpy(), head)
+    assert_almost_equal(gb.asnumpy(), head)
+
+
+def test_grad_req_add():
+    a = mx.sym.Variable("a")
+    out = a * 2.0
+    ga = mx.nd.zeros((2, 2))
+    ex = out.bind(mx.cpu(), {"a": mx.nd.ones((2, 2))}, args_grad={"a": ga},
+                  grad_req="add")
+    for i in range(3):
+        ex.forward(is_train=True)
+        ex.backward([mx.nd.ones((2, 2))])
+    assert_almost_equal(ga.asnumpy(), np.full((2, 2), 6.0))
+
+
+def test_simple_bind_and_outputs():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=3, name="fc")
+    ex = y.simple_bind(mx.cpu(), x=(5, 7))
+    assert set(ex.arg_dict) == {"x", "fc_weight", "fc_bias"}
+    assert ex.arg_dict["fc_weight"].shape == (3, 7)
+    ex.arg_dict["x"][:] = 1.0
+    ex.arg_dict["fc_weight"][:] = 1.0
+    ex.arg_dict["fc_bias"][:] = 0.5
+    out = ex.forward()[0]
+    assert_almost_equal(out.asnumpy(), np.full((5, 3), 7.5), rtol=1e-5)
+
+
+def test_executor_reshape():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    ex = y.simple_bind(mx.cpu(), x=(2, 6))
+    ex.arg_dict["fc_weight"][:] = 1.0
+    ex2 = ex.reshape(partial_shaping=True, x=(8, 6))
+    assert ex2.arg_dict["x"].shape == (8, 6)
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    ex2.arg_dict["x"][:] = 1.0
+    out = ex2.forward()[0]
+    assert out.shape == (8, 4)
+    assert_almost_equal(out.asnumpy(), np.full((8, 4), 6.0), rtol=1e-5)
+
+
+def test_forward_kwargs_update():
+    x = mx.sym.Variable("x")
+    y = x * 3.0
+    ex = y.simple_bind(mx.cpu(), grad_req="null", x=(2, 2))
+    out = ex.forward(x=mx.nd.ones((2, 2)))
+    assert_almost_equal(out[0].asnumpy(), np.full((2, 2), 3.0))
+    out = ex.forward(x=np.full((2, 2), 2.0, np.float32))
+    assert_almost_equal(out[0].asnumpy(), np.full((2, 2), 6.0))
+
+
+def test_aux_state_update():
+    data = mx.sym.Variable("data")
+    bn = mx.sym.BatchNorm(data, name="bn", momentum=0.5, fix_gamma=True)
+    ex = bn.simple_bind(mx.cpu(), data=(4, 3))
+    assert set(ex.aux_dict) == {"bn_moving_mean", "bn_moving_var"}
+    x = np.random.rand(4, 3).astype(np.float32) + 3.0
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    ex.forward(is_train=True, data=x)
+    # moving mean moved halfway toward batch mean (momentum=0.5)
+    assert_almost_equal(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                        0.5 * x.mean(0), rtol=1e-4)
+
+
+def test_multi_output_executor():
+    d = mx.sym.Variable("d")
+    s = mx.sym.SliceChannel(d, num_outputs=2, axis=1, name="sp")
+    grp = mx.sym.Group([s[0] * 1.0, s[1] * 2.0])
+    x = np.random.rand(3, 4).astype(np.float32)
+    ex = grp.bind(mx.cpu(), {"d": mx.nd.array(x)})
+    o1, o2 = ex.forward()
+    assert_almost_equal(o1.asnumpy(), x[:, :2])
+    assert_almost_equal(o2.asnumpy(), x[:, 2:] * 2.0)
+
+
+def test_monitor_callback():
+    taps = {}
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
+    ex = y.simple_bind(mx.cpu(), x=(1, 2))
+    ex.set_monitor_callback(lambda name, arr: taps.setdefault(name, arr.shape))
+    ex.forward()
+    assert any("fc" in k for k in taps)

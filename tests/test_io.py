@@ -1,0 +1,103 @@
+"""IO tests (modeled on tests/python/unittest/test_io.py)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_ndarray_iter_basic():
+    X = np.arange(100, dtype=np.float32).reshape(25, 4)
+    y = np.arange(25, dtype=np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (5, 4)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), X[:5])
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), y[:5])
+    # reset works
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_ndarray_iter_pad_discard():
+    X = np.arange(44, dtype=np.float32).reshape(11, 4)
+    it = mx.io.NDArrayIter(X, np.zeros(11), batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 1
+    it = mx.io.NDArrayIter(X, np.zeros(11), batch_size=4, last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_shuffle_multi_input():
+    X = {"a": np.random.rand(20, 2).astype(np.float32),
+         "b": np.random.rand(20, 3).astype(np.float32)}
+    it = mx.io.NDArrayIter(X, np.zeros(20, np.float32), batch_size=5, shuffle=True)
+    names = [d.name for d in it.provide_data]
+    assert sorted(names) == ["a", "b"]
+    batch = next(it)
+    assert len(batch.data) == 2
+
+
+def test_resize_iter():
+    X = np.random.rand(12, 3).astype(np.float32)
+    base = mx.io.NDArrayIter(X, np.zeros(12), batch_size=4)
+    it = mx.io.ResizeIter(base, size=7)
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    X = np.random.rand(16, 3).astype(np.float32)
+    base = mx.io.NDArrayIter(X, np.zeros(16), batch_size=4)
+    it = mx.io.PrefetchingIter(base)
+    total = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3)
+        total += 1
+    assert total == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(10, 3).astype(np.float32)
+    labels = np.arange(10, dtype=np.float32)
+    data_path = str(tmp_path / "data.csv")
+    label_path = str(tmp_path / "label.csv")
+    np.savetxt(data_path, data, delimiter=",")
+    np.savetxt(label_path, labels, delimiter=",")
+    it = mx.io.CSVIter(data_csv=data_path, data_shape=(3,), label_csv=label_path,
+                       batch_size=5)
+    batch = next(it)
+    np.testing.assert_allclose(batch.data[0].asnumpy(), data[:5], rtol=1e-5)
+
+
+def test_mnist_iter(tmp_path):
+    # write tiny idx files
+    import struct
+
+    imgs = (np.random.rand(10, 28, 28) * 255).astype(np.uint8)
+    labels = np.arange(10, dtype=np.uint8) % 10
+    img_path = str(tmp_path / "img-idx3-ubyte")
+    lbl_path = str(tmp_path / "lbl-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 3))
+        f.write(struct.pack(">III", 10, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 1))
+        f.write(struct.pack(">I", 10))
+        f.write(labels.tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=5, shuffle=False)
+    batch = next(it)
+    assert batch.data[0].shape == (5, 1, 28, 28)
+    np.testing.assert_allclose(batch.data[0].asnumpy(),
+                               imgs[:5, None].astype(np.float32) / 255.0, rtol=1e-5)
+    np.testing.assert_allclose(batch.label[0].asnumpy(), labels[:5])
+    flat_it = mx.io.MNISTIter(image=img_path, label=lbl_path, batch_size=5,
+                              shuffle=False, flat=True)
+    assert next(flat_it).data[0].shape == (5, 784)

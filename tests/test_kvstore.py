@@ -1,0 +1,88 @@
+"""KVStore tests (modeled on tests/python/unittest/test_kvstore.py —
+multi-device semantics exercised with N arrays per key on one host)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _init_kv():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def test_single_kv_pair():
+    kv = _init_kv()
+    kv.push(3, mx.nd.ones(SHAPE) * 4)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, 4.0))
+
+
+def test_aggregator_multi_devices():
+    # 4 "devices" push to one key → values summed (kvstore_local Reduce)
+    kv = _init_kv()
+    num_devs = 4
+    vals = [mx.nd.ones(SHAPE)] * num_devs
+    kv.push(3, vals)
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, num_devs))
+    # list keys
+    kv.push(KEYS, [[mx.nd.ones(SHAPE) * 2.0] * num_devs] * len(KEYS))
+    outs = [mx.nd.empty(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), np.full(SHAPE, 2.0 * num_devs))
+
+
+def test_updater():
+    kv = _init_kv()
+    updates = []
+
+    def my_updater(key, recv, stored):
+        updates.append(key)
+        stored += recv * 2.0
+
+    kv._set_updater(my_updater)
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, 2.0))
+    assert updates == [3]
+
+
+def test_optimizer_on_kvstore():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+    kv.push(0, mx.nd.ones(SHAPE))
+    out = mx.nd.empty(SHAPE)
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, 0.5))
+
+
+def test_kvstore_types():
+    for t in ["local", "device", "tpu", "dist_sync", "dist_async"]:
+        kv = mx.kv.create(t)
+        assert kv.type == t
+        assert kv.rank == 0
+        assert kv.num_workers == 1
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("bogus")
+
+
+def test_errors():
+    kv = _init_kv()
+    with pytest.raises(mx.MXNetError):
+        kv.init(3, mx.nd.zeros(SHAPE))  # duplicate
+    with pytest.raises(mx.MXNetError):
+        kv.push(999, mx.nd.zeros(SHAPE))
+    with pytest.raises(mx.MXNetError):
+        kv.pull(999, out=mx.nd.zeros(SHAPE))

@@ -1,0 +1,82 @@
+"""Metric tests."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    preds = [mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])]
+    labels = [mx.nd.array([1, 0, 0])]
+    m.update(labels, preds)
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    preds = [mx.nd.array([[0.1, 0.5, 0.4], [0.7, 0.2, 0.1]])]
+    labels = [mx.nd.array([2, 1])]
+    m.update(labels, preds)
+    assert abs(m.get()[1] - 1.0) < 1e-6  # both labels within top-2
+    m2 = metric.TopKAccuracy(top_k=2)
+    m2.update([mx.nd.array([0, 1])], preds)  # row0 label 0 not in top-2
+    assert abs(m2.get()[1] - 0.5) < 1e-6
+
+
+def test_mse_mae_rmse():
+    pred = [mx.nd.array([[1.0], [2.0]])]
+    label = [mx.nd.array([1.5, 2.5])]
+    m = metric.MSE(); m.update(label, pred)
+    assert abs(m.get()[1] - 0.25) < 1e-6
+    m = metric.MAE(); m.update(label, pred)
+    assert abs(m.get()[1] - 0.5) < 1e-6
+    m = metric.RMSE(); m.update(label, pred)
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_cross_entropy_f1():
+    pred = [mx.nd.array([[0.9, 0.1], [0.2, 0.8]])]
+    label = [mx.nd.array([0, 1])]
+    m = metric.CrossEntropy()
+    m.update(label, pred)
+    expected = -(np.log(0.9) + np.log(0.8)) / 2
+    assert abs(m.get()[1] - expected) < 1e-5
+    f = metric.F1()
+    f.update(label, pred)
+    assert f.get()[1] == 1.0
+
+
+def test_composite_and_custom():
+    comp = metric.CompositeEvalMetric(metrics=["acc", "mse"])
+    pred = [mx.nd.array([[0.1, 0.9]])]
+    label = [mx.nd.array([1])]
+    comp.update(label, pred)
+    names, vals = comp.get()
+    assert len(names) == 2
+
+    def my_metric(label, pred):
+        return float(np.abs(label - pred.argmax(1)).sum())
+
+    cm = metric.np(my_metric)
+    cm.update([np.array([1])], [np.array([[0.9, 0.1]])])
+    assert cm.get()[1] == 1.0
+
+
+def test_create_factory():
+    assert isinstance(metric.create("acc"), metric.Accuracy)
+    assert isinstance(metric.create(["acc", "ce"]), metric.CompositeEvalMetric)
+    m = metric.create(lambda l, p: 0.0)
+    assert isinstance(m, metric.CustomMetric)
+
+
+def test_perplexity():
+    m = metric.Perplexity(ignore_label=None)
+    pred = [mx.nd.array([[0.5, 0.5], [0.25, 0.75]])]
+    label = [mx.nd.array([0, 1])]
+    m.update(label, pred)
+    expected = np.exp(-(np.log(0.5) + np.log(0.75)) / 2)
+    assert abs(m.get()[1] - expected) < 1e-5

@@ -1,0 +1,135 @@
+"""Module tests (modeled on tests/python/unittest/test_module.py +
+tests/python/train/test_mlp.py convergence check)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _make_data(n=400, d=16, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = np.argmax(X @ rng.randn(d, k), axis=1).astype(np.float32)
+    return X, y
+
+
+def _mlp_sym(k=3):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=k, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_module_states_and_shapes():
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    assert not mod.binded
+    mod.bind(data_shapes=[("data", (8, 16))], label_shapes=[("softmax_label", (8,))])
+    assert mod.binded
+    assert mod.data_shapes == [("data", (8, 16))]
+    mod.init_params()
+    assert mod.params_initialized
+    arg_params, aux_params = mod.get_params()
+    assert set(arg_params) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+
+
+def test_module_fit_convergence():
+    X, y = _make_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            num_epoch=6)
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=20), "acc")
+    assert score[0][1] > 0.9, f"accuracy {score} too low"
+
+
+def test_module_predict():
+    X, y = _make_data(n=64)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (64, 3)
+    np.testing.assert_allclose(out.asnumpy().sum(1), np.ones(64), rtol=1e-4)
+
+
+def test_module_checkpoint_roundtrip():
+    X, y = _make_data(n=100)
+    train = mx.io.NDArrayIter(X, y, batch_size=10)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+            num_epoch=2)
+    ref = mod.score(mx.io.NDArrayIter(X, y, batch_size=10), "acc")[0][1]
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "model")
+        mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0002.params")
+        mod2 = mx.mod.Module.load(prefix, 2)
+        it = mx.io.NDArrayIter(X, y, batch_size=10)
+        mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+                  for_training=False)
+        got = mod2.score(it, "acc")[0][1]
+        assert abs(got - ref) < 1e-6
+
+
+def test_module_input_grads():
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 16))], label_shapes=[("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    X, y = _make_data(n=4)
+    batch = mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    (din,) = mod.get_input_grads()
+    assert din.shape == (4, 16)
+    assert np.abs(din.asnumpy()).sum() > 0
+
+
+def test_module_fixed_params():
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu(), fixed_param_names=["fc1_weight"])
+    mod.bind(data_shapes=[("data", (4, 16))], label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    w_before = mod._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    X, y = _make_data(n=4)
+    batch = mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(y)])
+    mod.forward_backward(batch)
+    mod.update()
+    w_after = mod._exec.arg_dict["fc1_weight"].asnumpy()
+    np.testing.assert_array_equal(w_before, w_after)
+
+
+def test_module_kvstore_local():
+    X, y = _make_data()
+    train = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd", kvstore="local",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9}, num_epoch=4)
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=20), "acc")
+    assert score[0][1] > 0.85
+
+
+def test_module_bucketing_shared():
+    # shared-module rebinding path used by BucketingModule
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 16))], label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod2 = mx.mod.Module(sym, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (4, 16))], label_shapes=[("softmax_label", (4,))],
+              shared_module=mod)
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    np.testing.assert_allclose(a1["fc1_weight"].asnumpy(), a2["fc1_weight"].asnumpy())

@@ -1,0 +1,330 @@
+"""Operator tests vs numpy references + numeric gradients
+(modeled on tests/python/unittest/test_operator.py, 71 tests)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward, check_symbolic_backward,
+                                  simple_forward)
+
+RNG = np.random.RandomState(7)
+
+
+def test_elemwise_ops_forward():
+    x = RNG.rand(3, 4).astype(np.float32) + 0.5
+    cases = {
+        "exp": np.exp, "log": np.log, "sqrt": np.sqrt, "square": np.square,
+        "abs": np.abs, "sign": np.sign, "ceil": np.ceil, "floor": np.floor,
+        "sin": np.sin, "cos": np.cos, "tanh": np.tanh, "sigmoid": lambda v: 1 / (1 + np.exp(-v)),
+    }
+    for name, ref in cases.items():
+        out = getattr(mx.nd, name)(mx.nd.array(x)).asnumpy()
+        assert_almost_equal(out, ref(x), rtol=1e-4, atol=1e-5, names=(name, "np"))
+
+
+def test_unary_gradients():
+    x = RNG.rand(2, 3).astype(np.float32) + 0.5
+    for op in ["exp", "log", "sqrt", "square", "tanh", "sigmoid"]:
+        sym = getattr(mx.sym, op)(mx.sym.Variable("x"))
+        check_numeric_gradient(sym, {"x": x}, rtol=5e-2)
+
+
+def test_binary_broadcast():
+    a = RNG.rand(2, 3, 4).astype(np.float32) + 0.5
+    b = RNG.rand(1, 3, 1).astype(np.float32) + 0.5
+    for name, ref in [("broadcast_add", np.add), ("broadcast_mul", np.multiply),
+                      ("broadcast_sub", np.subtract), ("broadcast_div", np.divide),
+                      ("broadcast_maximum", np.maximum), ("broadcast_minimum", np.minimum)]:
+        out = getattr(mx.nd, name)(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+        assert_almost_equal(out, ref(a, b), rtol=1e-5, names=(name, "np"))
+    sym = mx.sym.broadcast_mul(mx.sym.Variable("a"), mx.sym.Variable("b"))
+    check_numeric_gradient(sym, {"a": a, "b": b}, rtol=5e-2)
+
+
+def test_reduce_ops():
+    x = RNG.rand(2, 3, 4).astype(np.float32)
+    assert_almost_equal(mx.nd.sum(mx.nd.array(x), axis=1).asnumpy(), x.sum(1), rtol=1e-5)
+    assert_almost_equal(mx.nd.sum(mx.nd.array(x), axis=(0, 2)).asnumpy(), x.sum((0, 2)), rtol=1e-5)
+    assert_almost_equal(mx.nd.mean(mx.nd.array(x), axis=2, keepdims=True).asnumpy(),
+                        x.mean(2, keepdims=True), rtol=1e-5)
+    assert_almost_equal(mx.nd.argmax(mx.nd.array(x), axis=1).asnumpy(), np.argmax(x, 1))
+    assert_almost_equal(mx.nd.norm(mx.nd.array(x)).asnumpy(),
+                        np.array([np.sqrt((x ** 2).sum())]), rtol=1e-5)
+
+
+def test_dot_ops():
+    a = RNG.rand(4, 5).astype(np.float32)
+    b = RNG.rand(5, 3).astype(np.float32)
+    assert_almost_equal(mx.nd.dot(mx.nd.array(a), mx.nd.array(b)).asnumpy(), a @ b, rtol=1e-4)
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(a), mx.nd.array(b.T), transpose_b=True).asnumpy(),
+        a @ b, rtol=1e-4)
+    batch_a = RNG.rand(6, 4, 5).astype(np.float32)
+    batch_b = RNG.rand(6, 5, 3).astype(np.float32)
+    assert_almost_equal(mx.nd.batch_dot(mx.nd.array(batch_a), mx.nd.array(batch_b)).asnumpy(),
+                        np.einsum("bij,bjk->bik", batch_a, batch_b), rtol=1e-4)
+    sym = mx.sym.dot(mx.sym.Variable("a"), mx.sym.Variable("b"))
+    check_numeric_gradient(sym, {"a": a, "b": b}, rtol=5e-2)
+
+
+def test_shape_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    nd = mx.nd.array(x)
+    assert_almost_equal(mx.nd.Reshape(nd, shape=(6, 4)).asnumpy(), x.reshape(6, 4))
+    assert_almost_equal(mx.nd.Reshape(nd, shape=(0, -1)).asnumpy(), x.reshape(2, 12))
+    assert_almost_equal(mx.nd.Reshape(nd, shape=(-1, 0), reverse=True).asnumpy(),
+                        x.reshape(-1, 4))
+    assert_almost_equal(mx.nd.Flatten(nd).asnumpy(), x.reshape(2, 12))
+    assert_almost_equal(mx.nd.expand_dims(nd, axis=1).asnumpy(), x[:, None])
+    assert_almost_equal(mx.nd.transpose(nd, axes=(2, 0, 1)).asnumpy(), x.transpose(2, 0, 1))
+    assert_almost_equal(mx.nd.slice_axis(nd, axis=2, begin=1, end=3).asnumpy(), x[:, :, 1:3])
+    assert_almost_equal(mx.nd.flip(nd, axis=2).asnumpy(), x[:, :, ::-1])
+    assert_almost_equal(mx.nd.tile(nd, reps=(1, 2, 1)).asnumpy(), np.tile(x, (1, 2, 1)))
+    assert_almost_equal(mx.nd.repeat(nd, repeats=2, axis=1).asnumpy(), np.repeat(x, 2, 1))
+
+
+def test_concat_split():
+    a = RNG.rand(2, 3).astype(np.float32)
+    b = RNG.rand(2, 5).astype(np.float32)
+    out = mx.nd.Concat(mx.nd.array(a), mx.nd.array(b), num_args=2, dim=1)
+    assert_almost_equal(out.asnumpy(), np.concatenate([a, b], 1))
+    x = RNG.rand(2, 6).astype(np.float32)
+    outs = mx.nd.SliceChannel(mx.nd.array(x), num_outputs=3, axis=1)
+    for i, o in enumerate(outs):
+        assert_almost_equal(o.asnumpy(), x[:, 2 * i:2 * i + 2])
+    # symbolic concat gradient
+    sym = mx.sym.Concat(mx.sym.Variable("a"), mx.sym.Variable("b"), num_args=2, dim=1)
+    check_numeric_gradient(sym, {"a": a, "b": b}, rtol=5e-2)
+
+
+def test_fullyconnected():
+    x = RNG.rand(4, 10).astype(np.float32)
+    w = RNG.rand(5, 10).astype(np.float32)
+    b = RNG.rand(5).astype(np.float32)
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b), num_hidden=5)
+    assert_almost_equal(out.asnumpy(), x @ w.T + b, rtol=1e-4)
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=5, name="fc")
+    check_numeric_gradient(sym, {"data": x, "fc_weight": w, "fc_bias": b}, rtol=5e-2)
+
+
+def test_activation_ops():
+    x = RNG.randn(3, 4).astype(np.float32)
+    nd = mx.nd.array(x)
+    assert_almost_equal(mx.nd.Activation(nd, act_type="relu").asnumpy(), np.maximum(x, 0))
+    assert_almost_equal(mx.nd.Activation(nd, act_type="tanh").asnumpy(), np.tanh(x), rtol=1e-5)
+    assert_almost_equal(mx.nd.LeakyReLU(nd, act_type="leaky", slope=0.1).asnumpy(),
+                        np.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    elu = mx.nd.LeakyReLU(nd, act_type="elu", slope=0.3).asnumpy()
+    assert_almost_equal(elu, np.where(x > 0, x, 0.3 * np.expm1(x)), rtol=1e-5)
+
+
+def test_convolution_forward():
+    # compare against explicit correlation
+    x = RNG.rand(2, 3, 7, 7).astype(np.float32)
+    w = RNG.rand(4, 3, 3, 3).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                            kernel=(3, 3), num_filter=4).asnumpy()
+    ref = np.zeros((2, 4, 5, 5), np.float32)
+    for n in range(2):
+        for f in range(4):
+            for i in range(5):
+                for j in range(5):
+                    ref[n, f, i, j] = (x[n, :, i:i + 3, j:j + 3] * w[f]).sum()
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_convolution_grad():
+    x = RNG.rand(1, 2, 5, 5).astype(np.float32)
+    sym = mx.sym.Convolution(mx.sym.Variable("data"), kernel=(3, 3), num_filter=2,
+                             pad=(1, 1), name="conv")
+    check_numeric_gradient(
+        sym, {"data": x,
+              "conv_weight": RNG.rand(2, 2, 3, 3).astype(np.float32) * 0.1,
+              "conv_bias": np.zeros(2, np.float32)}, rtol=8e-2)
+
+
+def test_pooling():
+    x = RNG.rand(1, 2, 6, 6).astype(np.float32)
+    out = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max").asnumpy()
+    ref = x.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5))
+    assert_almost_equal(out, ref)
+    out = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg").asnumpy()
+    assert_almost_equal(out, x.reshape(1, 2, 3, 2, 3, 2).mean(axis=(3, 5)), rtol=1e-5)
+    gout = mx.nd.Pooling(mx.nd.array(x), kernel=(1, 1), global_pool=True, pool_type="max").asnumpy()
+    assert_almost_equal(gout[..., 0, 0], x.max(axis=(2, 3)))
+    # full convention: 6->3 with k=2,s=2 same; try k=3,s=2: valid->2, full->3
+    out_v = mx.nd.Pooling(mx.nd.array(x), kernel=(3, 3), stride=(2, 2), pool_type="max",
+                          pooling_convention="valid").asnumpy()
+    assert out_v.shape == (1, 2, 2, 2)
+    out_f = mx.nd.Pooling(mx.nd.array(x), kernel=(3, 3), stride=(2, 2), pool_type="max",
+                          pooling_convention="full").asnumpy()
+    assert out_f.shape == (1, 2, 3, 3)
+
+
+def test_batchnorm_train_inference():
+    x = RNG.rand(8, 3, 4, 4).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mm = np.zeros(3, np.float32)
+    mv = np.ones(3, np.float32)
+    g, b = mx.nd.array(gamma), mx.nd.array(beta)
+    mm_nd, mv_nd = mx.nd.array(mm), mx.nd.array(mv)
+    out = mx.nd.BatchNorm(mx.nd.array(x), g, b, mm_nd, mv_nd, is_train=True,
+                          eps=1e-3, momentum=0.9)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-3)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-3, atol=1e-4)
+    # moving stats updated
+    assert_almost_equal(mm_nd.asnumpy(), 0.9 * mm + 0.1 * mean, rtol=1e-4)
+    assert_almost_equal(mv_nd.asnumpy(), 0.9 * mv + 0.1 * var, rtol=1e-4)
+    # inference uses moving stats
+    out_inf = mx.nd.BatchNorm(mx.nd.array(x), g, b, mx.nd.array(mm), mx.nd.array(mv),
+                              is_train=False, eps=1e-3)
+    ref_inf = (x - mm[None, :, None, None]) / np.sqrt(mv[None, :, None, None] + 1e-3)
+    assert_almost_equal(out_inf.asnumpy(), ref_inf, rtol=1e-3, atol=1e-4)
+
+
+def test_softmax_output_grad():
+    # backward = (p - onehot) * scale, ignoring head grads
+    x = RNG.rand(4, 5).astype(np.float32)
+    label = np.array([0, 2, 4, 1], np.float32)
+    sym = mx.sym.SoftmaxOutput(mx.sym.Variable("data"), mx.sym.Variable("label"))
+    p = np.exp(x - x.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    onehot = np.eye(5)[label.astype(int)]
+    check_symbolic_forward(sym, {"data": x, "label": label}, p, rtol=1e-4)
+    check_symbolic_backward(sym, {"data": x, "label": label},
+                            out_grads=[np.ones((4, 5), np.float32)],
+                            expected={"data": p - onehot}, rtol=1e-4)
+
+
+def test_regression_outputs():
+    x = RNG.rand(4, 3).astype(np.float32)
+    y = RNG.rand(4, 3).astype(np.float32)
+    lin = mx.sym.LinearRegressionOutput(mx.sym.Variable("data"), mx.sym.Variable("label"))
+    check_symbolic_forward(lin, {"data": x, "label": y}, x)
+    check_symbolic_backward(lin, {"data": x, "label": y},
+                            out_grads=[np.ones_like(x)],
+                            expected={"data": (x - y) / 3.0}, rtol=1e-4)
+    log = mx.sym.LogisticRegressionOutput(mx.sym.Variable("data"), mx.sym.Variable("label"))
+    sig = 1 / (1 + np.exp(-x))
+    check_symbolic_forward(log, {"data": x, "label": y}, sig, rtol=1e-5)
+    check_symbolic_backward(log, {"data": x, "label": y},
+                            out_grads=[np.ones_like(x)],
+                            expected={"data": (sig - y) / 3.0}, rtol=1e-4)
+
+
+def test_block_grad_and_makeloss():
+    x = RNG.rand(3, 3).astype(np.float32)
+    v = mx.sym.Variable("x")
+    blocked = mx.sym.BlockGrad(v * 2.0)
+    g = mx.nd.zeros((3, 3))
+    ex = blocked.bind(mx.cpu(), {"x": mx.nd.array(x)}, args_grad={"x": g})
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.ones((3, 3))])
+    assert (g.asnumpy() == 0).all()
+    ml = mx.sym.MakeLoss(v * 3.0, grad_scale=2.0)
+    g2 = mx.nd.zeros((3, 3))
+    ex2 = ml.bind(mx.cpu(), {"x": mx.nd.array(x)}, args_grad={"x": g2})
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert_almost_equal(g2.asnumpy(), np.full((3, 3), 6.0), rtol=1e-5)
+
+
+def test_embedding_take():
+    w = RNG.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5], np.float32)
+    out = mx.nd.Embedding(mx.nd.array(idx), mx.nd.array(w), input_dim=10, output_dim=4)
+    assert_almost_equal(out.asnumpy(), w[[1, 3, 5]])
+    sym = mx.sym.Embedding(mx.sym.Variable("data"), input_dim=10, output_dim=4, name="emb")
+    check_numeric_gradient(sym, {"data": idx, "emb_weight": w},
+                           grad_nodes=["emb_weight"], rtol=5e-2)
+    out2 = mx.nd.take(mx.nd.array(w), mx.nd.array(idx))
+    assert_almost_equal(out2.asnumpy(), w[[1, 3, 5]])
+    oh = mx.nd.one_hot(mx.nd.array(idx), depth=10)
+    assert_almost_equal(oh.asnumpy(), np.eye(10)[[1, 3, 5]])
+
+
+def test_dropout():
+    x = np.ones((200, 200), np.float32)
+    out = mx.nd.Dropout(mx.nd.array(x), p=0.5, is_train=True).asnumpy()
+    frac = (out == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = out[out != 0]
+    assert np.allclose(kept, 2.0)
+    out_inf = mx.nd.Dropout(mx.nd.array(x), p=0.5, is_train=False).asnumpy()
+    assert (out_inf == 1).all()
+
+
+def test_ordering_ops():
+    x = RNG.rand(4, 6).astype(np.float32)
+    assert_almost_equal(mx.nd.sort(mx.nd.array(x), axis=1).asnumpy(), np.sort(x, 1))
+    assert_almost_equal(mx.nd.argsort(mx.nd.array(x), axis=1).asnumpy(), np.argsort(x, 1))
+    vals = mx.nd.topk(mx.nd.array(x), k=2, ret_typ="value", axis=1).asnumpy()
+    ref = np.sort(x, 1)[:, ::-1][:, :2]
+    assert_almost_equal(vals, ref, rtol=1e-5)
+
+
+def test_sequence_ops():
+    # (T, B, D)
+    x = RNG.rand(4, 3, 2).astype(np.float32)
+    seqlen = np.array([2, 4, 1], np.float32)
+    last = mx.nd.SequenceLast(mx.nd.array(x), mx.nd.array(seqlen), use_sequence_length=True)
+    ref = np.stack([x[1, 0], x[3, 1], x[0, 2]])
+    assert_almost_equal(last.asnumpy(), ref)
+    masked = mx.nd.SequenceMask(mx.nd.array(x), mx.nd.array(seqlen),
+                                use_sequence_length=True, value=0.0).asnumpy()
+    assert (masked[2:, 0] == 0).all() and (masked[1:, 2] == 0).all()
+    assert_almost_equal(masked[:2, 0], x[:2, 0])
+    rev = mx.nd.SequenceReverse(mx.nd.array(x), mx.nd.array(seqlen),
+                                use_sequence_length=True).asnumpy()
+    assert_almost_equal(rev[0, 0], x[1, 0])
+    assert_almost_equal(rev[1, 0], x[0, 0])
+
+
+def test_upsampling_pad():
+    x = RNG.rand(1, 2, 3, 3).astype(np.float32)
+    up = mx.nd.UpSampling(mx.nd.array(x), scale=2, sample_type="nearest").asnumpy()
+    assert up.shape == (1, 2, 6, 6)
+    assert_almost_equal(up[:, :, ::2, ::2], x)
+    padded = mx.nd.Pad(mx.nd.array(x), mode="constant",
+                       pad_width=(0, 0, 0, 0, 1, 1, 2, 2), constant_value=5).asnumpy()
+    assert padded.shape == (1, 2, 5, 7)
+    assert (padded[:, :, 0, :] == 5).all()
+
+
+def test_lrn_l2norm():
+    x = RNG.rand(2, 4, 3, 3).astype(np.float32)
+    out = mx.nd.LRN(mx.nd.array(x), nsize=3, alpha=1e-4, beta=0.75, knorm=2.0).asnumpy()
+    assert out.shape == x.shape
+    l2 = mx.nd.L2Normalization(mx.nd.array(x), mode="instance").asnumpy()
+    flat = x.reshape(2, -1)
+    ref = (flat / np.sqrt((flat ** 2).sum(1, keepdims=True) + 1e-10)).reshape(x.shape)
+    assert_almost_equal(l2, ref, rtol=1e-4)
+
+
+def test_where_cast():
+    cond = np.array([[1, 0], [0, 1]], np.float32)
+    a = np.ones((2, 2), np.float32)
+    b = np.zeros((2, 2), np.float32)
+    out = mx.nd.where(mx.nd.array(cond), mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    assert_almost_equal(out, cond)
+    c = mx.nd.Cast(mx.nd.array(a), dtype="int32")
+    assert c.dtype == np.int32
+
+
+def test_deconvolution():
+    x = RNG.rand(1, 3, 4, 4).astype(np.float32)
+    w = RNG.rand(3, 2, 3, 3).astype(np.float32) * 0.1
+    out = mx.nd.Deconvolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                              num_filter=2, stride=(2, 2), pad=(1, 1), adj=(1, 1),
+                              no_bias=True)
+    assert out.shape == (1, 2, 8, 8)
+    sym = mx.sym.Deconvolution(mx.sym.Variable("data"), kernel=(2, 2), num_filter=2,
+                               stride=(2, 2), no_bias=True, name="dc")
+    check_numeric_gradient(sym, {"data": x, "dc_weight": RNG.rand(3, 2, 2, 2).astype(np.float32) * 0.1},
+                           rtol=8e-2)

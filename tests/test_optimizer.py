@@ -1,0 +1,110 @@
+"""Optimizer tests (modeled on tests/python/unittest/test_optimizer.py —
+each optimizer compared against a numpy reference implementation)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run_steps(optimizer, w0, grads):
+    w = mx.nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for g in grads:
+        state = optimizer.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    rng = np.random.RandomState(0)
+    w0 = rng.rand(4, 5).astype(np.float32)
+    grads = [rng.rand(4, 5).astype(np.float32) for _ in range(5)]
+    got = _run_steps(opt.create("sgd", learning_rate=0.1, momentum=0.9, wd=0.01,
+                                rescale_grad=0.5), w0, grads)
+    w = w0.copy()
+    mom = np.zeros_like(w)
+    for g in grads:
+        g2 = g * 0.5 + 0.01 * w
+        mom = 0.9 * mom - 0.1 * g2
+        w = w + mom
+    assert_almost_equal(got, w, rtol=1e-5)
+
+
+def test_sgd_no_momentum_clip():
+    w0 = np.ones((3,), np.float32)
+    g = np.array([10.0, -10.0, 0.1], np.float32)
+    got = _run_steps(opt.create("sgd", learning_rate=1.0, clip_gradient=1.0), w0, [g])
+    assert_almost_equal(got, w0 - np.clip(g, -1, 1), rtol=1e-6)
+
+
+def test_adam_matches_numpy():
+    rng = np.random.RandomState(1)
+    w0 = rng.rand(6).astype(np.float32)
+    grads = [rng.rand(6).astype(np.float32) for _ in range(4)]
+    got = _run_steps(opt.create("adam", learning_rate=0.01, beta1=0.9, beta2=0.999,
+                                epsilon=1e-8), w0, grads)
+    w = w0.copy().astype(np.float64)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        g = g.astype(np.float64)
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        lr_t = 0.01 * np.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        w -= lr_t * m / (np.sqrt(v) + 1e-8)
+    assert_almost_equal(got, w, rtol=1e-4)
+
+
+def test_adagrad_rmsprop_adadelta_run():
+    rng = np.random.RandomState(2)
+    w0 = rng.rand(8).astype(np.float32)
+    grads = [rng.rand(8).astype(np.float32) for _ in range(3)]
+    for name in ["adagrad", "rmsprop", "adadelta", "nag", "dcasgd", "test"]:
+        got = _run_steps(opt.create(name), w0, grads)
+        assert got.shape == w0.shape
+        assert not np.allclose(got, w0), f"{name} did not update weights"
+        assert np.isfinite(got).all()
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+
+    sched = FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(1) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+    m = MultiFactorScheduler(step=[5, 15], factor=0.1)
+    m.base_lr = 1.0
+    assert m(1) == 1.0
+    assert abs(m(6) - 0.1) < 1e-12
+    assert abs(m(16) - 0.01) < 1e-12
+
+
+def test_lr_wd_mult():
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w", lr_mult=0.0)
+    net = mx.sym.FullyConnected(data, weight=w, num_hidden=2, no_bias=True, name="fc")
+    o = opt.create("sgd", learning_rate=1.0, sym=net,
+                   param_idx2name={0: "w"})
+    wt = mx.nd.ones((2, 3))
+    state = o.create_state(0, wt)
+    o.update(0, wt, mx.nd.ones((2, 3)), state)
+    # lr_mult 0 → no change
+    np.testing.assert_allclose(wt.asnumpy(), np.ones((2, 3)))
+
+
+def test_updater_states_roundtrip():
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(o)
+    w = mx.nd.ones((4,))
+    upd(0, mx.nd.ones((4,)), w)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.create("sgd", learning_rate=0.1, momentum=0.9))
+    upd2.set_states(blob)
+    w2 = mx.nd.array(w.asnumpy())
+    upd(0, mx.nd.ones((4,)), w)
+    upd2(0, mx.nd.ones((4,)), w2)
+    np.testing.assert_allclose(w.asnumpy(), w2.asnumpy(), rtol=1e-6)

@@ -1,0 +1,121 @@
+"""Symbol tests (modeled on tests/python/unittest/test_symbol.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=10, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_symbol_basics():
+    sym = _mlp()
+    assert sym.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias", "softmax_label"]
+    assert sym.list_outputs() == ["softmax_output"]
+    internals = sym.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_outputs() == ["fc1_output"]
+
+
+def test_symbol_compose():
+    data = mx.sym.Variable("data")
+    net1 = mx.sym.FullyConnected(data, num_hidden=10, name="fc1")
+    net1 = mx.sym.FullyConnected(net1, num_hidden=100, name="fc2")
+    net2 = mx.sym.FullyConnected(mx.sym.Variable("data2"), num_hidden=10, name="fc3")
+    net2 = mx.sym.Activation(net2, act_type="relu")
+    net2 = mx.sym.FullyConnected(net2, num_hidden=20, name="fc4")
+    composed = net2(data2=net1, name="composed")
+    assert "fc2_weight" in composed.list_arguments()
+    multi_out = mx.sym.Group([composed, net1])
+    assert len(multi_out.list_outputs()) == 2
+
+
+def test_symbol_infer_shape():
+    sym = _mlp()
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(data=(8, 30), softmax_label=(8,))
+    assert arg_shapes[1] == (10, 30)  # fc1_weight
+    assert arg_shapes[3] == (4, 10)  # fc2_weight
+    assert out_shapes == [(8, 4)]
+    # partial
+    a, o, _ = sym.infer_shape_partial(softmax_label=(8,))
+    assert a[0] is None
+
+
+def test_symbol_infer_shape_conv():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16, pad=(1, 1), name="c1")
+    pool = mx.sym.Pooling(conv, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, _ = pool.infer_shape(data=(2, 3, 8, 8))
+    assert arg_shapes[1] == (16, 3, 3, 3)
+    assert out_shapes == [(2, 16, 4, 4)]
+
+
+def test_symbol_json_roundtrip():
+    sym = _mlp()
+    js = sym.tojson()
+    data = json.loads(js)
+    assert "nodes" in data and "heads" in data
+    sym2 = mx.symbol.load_json(js)
+    assert sym2.list_arguments() == sym.list_arguments()
+    assert sym2.list_outputs() == sym.list_outputs()
+    # numerically identical executors
+    x = np.random.rand(2, 6).astype(np.float32)
+    args = {n: mx.nd.array(np.random.rand(*s).astype(np.float32))
+            for n, s in zip(sym.list_arguments(),
+                            sym.infer_shape(data=(2, 6), softmax_label=(2,))[0])}
+    e1 = sym.bind(mx.cpu(), args)
+    e2 = sym2.bind(mx.cpu(), args)
+    np.testing.assert_allclose(e1.forward()[0].asnumpy(),
+                               e2.forward()[0].asnumpy(), rtol=1e-6)
+
+
+def test_symbol_arithmetic():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = (a + b) * 2.0 - a / b + 1.5 - (-b)
+    av = np.array([[2.0, 4.0]], np.float32)
+    bv = np.array([[1.0, 2.0]], np.float32)
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array(av), "b": mx.nd.array(bv)})
+    expected = (av + bv) * 2.0 - av / bv + 1.5 + bv
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), expected, rtol=1e-6)
+
+
+def test_symbol_attr():
+    with mx.AttrScope(ctx_group="dev1"):
+        v = mx.sym.Variable("v")
+    assert v.attr("ctx_group") == "dev1"
+    v2 = mx.sym.Variable("w", lr_mult=2.0, wd_mult=0.5, shape=(3, 4))
+    d = v2.attr_dict()["w"]
+    assert d["lr_mult"] == "2.0" and d["wd_mult"] == "0.5"
+    # shape hint used in inference
+    fc = mx.sym.FullyConnected(v2, num_hidden=2, no_bias=True, name="fc")
+    args, outs, _ = fc.infer_shape()
+    assert outs == [(3, 2)]
+
+
+def test_symbol_variable_dup_and_save(tmp_path):
+    sym = _mlp()
+    path = str(tmp_path / "m-symbol.json")
+    sym.save(path)
+    loaded = mx.symbol.load(path)
+    assert loaded.list_arguments() == sym.list_arguments()
+
+
+def test_symbol_multi_output_indexing():
+    d = mx.sym.Variable("d")
+    split = mx.sym.SliceChannel(d, num_outputs=3, axis=1, name="split")
+    assert len(split.list_outputs()) == 3
+    one = split[1]
+    x = np.random.rand(2, 6).astype(np.float32)
+    ex = one.bind(mx.cpu(), {"d": mx.nd.array(x)})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), x[:, 2:4], rtol=1e-6)
