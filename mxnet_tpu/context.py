@@ -38,6 +38,7 @@ class Context:
             self.device_typeid = Context.devstr2type[device_type]
             self.device_id = device_id
         self._old_ctx: Optional[Context] = None
+        self._jax_device = None
 
     @property
     def device_type(self) -> str:
@@ -77,11 +78,15 @@ class Context:
         reference scripts with ``mx.gpu()`` work); falls back to CPU
         when no accelerator is present.
         """
+        if self._jax_device is not None:
+            return self._jax_device
         if self.device_type in ("cpu", "cpu_pinned"):
             devs = jax.devices("cpu")
-            return devs[self.device_id % len(devs)]
-        devs = _accelerator_devices()
-        return devs[self.device_id % len(devs)]
+            self._jax_device = devs[self.device_id % len(devs)]
+        else:
+            devs = _accelerator_devices()
+            self._jax_device = devs[self.device_id % len(devs)]
+        return self._jax_device
 
 
 def _accelerator_devices():
