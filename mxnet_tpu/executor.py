@@ -126,10 +126,11 @@ class Executor:
         self._jit_fwd = jax.jit(functools.partial(self._fwd, is_train=False))
         self._jit_fwd_train = jax.jit(functools.partial(self._fwd, is_train=True))
         self._jit_fused = jax.jit(self._fused)
+        self._jit_fused_ones = jax.jit(self._fused_ones)
         self.outputs_cache: List[NDArray] = []
         self._train_snapshot = None
+        self._cached_grads = None
         self._internals_fns: Dict[bool, Any] = {}
-        self._head_shape_cache: Dict[tuple, list] = {}
 
     # ------------------------------------------------------------------
     def _to_dict(self, values, names, what, allow_missing=False) -> Dict[str, NDArray]:
@@ -167,6 +168,38 @@ class Executor:
         grads = vjp_fn(tuple(heads))[0]
         return list(outs), new_aux, grads
 
+    def _fused_ones(self, arg_vals, aux_vals, rng):
+        """Fused fwd+bwd with the default all-ones head gradients (the
+        loss-head convention: custom VJPs of loss ops ignore the head).
+        One XLA program yields outputs, aux updates and grads."""
+        grad_names = self._grad_names
+
+        def f(grad_args):
+            full = dict(arg_vals)
+            full.update(grad_args)
+            outs, new_aux = self._graph_fn(full, aux_vals, rng, True)
+            return tuple(outs), new_aux
+
+        grad_args = {n: arg_vals[n] for n in grad_names}
+        (outs, vjp_fn, new_aux) = jax.vjp(f, grad_args, has_aux=True)
+        heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+        grads = vjp_fn(heads)[0]
+        return list(outs), new_aux, grads
+
+    def _outputs_all_loss_heads(self) -> bool:
+        """True when default all-ones head gradients are safe: every
+        output is a loss head (custom VJP ignores the head) or a
+        BlockGrad (VJP is zero)."""
+        from .ops.registry import get_op
+
+        for node, _ in self._symbol._outputs:
+            if node.is_variable:
+                return False
+            op = get_op(node.op)
+            if not op.is_loss and op.name != "BlockGrad":
+                return False
+        return True
+
     # ------------------------------------------------------------------
     @property
     def outputs(self) -> List[NDArray]:
@@ -185,17 +218,26 @@ class Executor:
         aux_vals = {n: a._data for n, a in self.aux_dict.items()}
         rng = _random.next_key()
         self._train_snapshot = None
+        self._cached_grads = None
 
         if self._monitor_callback is not None:
             self._run_monitor(arg_vals, aux_vals, rng, is_train)
 
-        fn = self._jit_fwd_train if is_train else self._jit_fwd
-        outs, new_aux = fn(arg_vals, aux_vals, rng)
-        if is_train and self._grad_names:
-            # stash the *pristine* inputs + rng so backward's fused
-            # recompute reproduces this forward exactly (same dropout
-            # masks, same pre-update aux)
+        if is_train and self._grad_names and self._outputs_all_loss_heads():
+            # training step on a loss-head graph: run the single fused
+            # fwd+bwd program now and cache the grads — backward() then
+            # just writes them out, so fwd+bwd costs ONE program run
+            outs, new_aux, grads = self._jit_fused_ones(arg_vals, aux_vals, rng)
+            self._cached_grads = grads
             self._train_snapshot = (arg_vals, aux_vals, rng)
+        else:
+            fn = self._jit_fwd_train if is_train else self._jit_fwd
+            outs, new_aux = fn(arg_vals, aux_vals, rng)
+            if is_train and self._grad_names:
+                # stash the *pristine* inputs + rng so a later
+                # backward(out_grads) reproduces this forward exactly
+                # (same dropout masks, same pre-update aux)
+                self._train_snapshot = (arg_vals, aux_vals, rng)
         for name, val in new_aux.items():
             self.aux_dict[name]._set_data(val)
         self.outputs_cache = [NDArray(o, self._ctx) for o in outs]
@@ -204,29 +246,36 @@ class Executor:
     def backward(self, out_grads=None):
         """reference: MXExecutorBackward; writes grads per grad_req.
 
-        Runs the fused forward+backward XLA program on the inputs
-        snapshotted by the last ``forward(is_train=True)`` — one
-        program, deterministic (same PRNG key), aux updates discarded
-        (already applied by forward)."""
+        With no ``out_grads``, consumes the gradients already computed by
+        the fused program ``forward(is_train=True)`` ran — fwd+bwd is ONE
+        XLA program run.  With explicit ``out_grads``, re-runs the fused
+        program on the snapshotted inputs with those head gradients (same
+        PRNG key; aux updates discarded — already applied by forward)."""
         if not self._grad_names:
             return
         if self._train_snapshot is None:
             raise MXNetError("backward() called before forward(is_train=True)")
-        arg_vals, aux_vals, rng = self._train_snapshot
         if out_grads is None:
-            sig = tuple((n, v.shape, str(v.dtype)) for n, v in sorted(arg_vals.items()))
-            out_shapes = self._head_shape_cache.get(sig)
-            if out_shapes is None:
-                out_shapes = [(o.shape, o.dtype) for o in jax.eval_shape(
-                    self._jit_fwd_train, arg_vals, aux_vals, rng)[0]]
-                self._head_shape_cache[sig] = out_shapes
-            heads = [jnp.ones(s, d) for s, d in out_shapes]
+            grads = self._cached_grads
+            if grads is None:
+                # graph has non-loss outputs: all-ones heads would sum
+                # unrelated gradients into the params (the reference only
+                # attaches gradient to loss heads, graph_executor.cc:167)
+                raise MXNetError(
+                    "backward() without out_grads requires every output to be "
+                    "a loss head (SoftmaxOutput/*RegressionOutput/MakeLoss/"
+                    "SVMOutput); pass explicit out_grads for non-loss outputs")
         else:
+            arg_vals, aux_vals, rng = self._train_snapshot
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             heads = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                      for g in out_grads]
-        _, _, grads = self._jit_fused(arg_vals, aux_vals, rng, heads)
+            if len(heads) != len(self.output_names):
+                raise MXNetError(
+                    f"out_grads has {len(heads)} entries for "
+                    f"{len(self.output_names)} outputs")
+            _, _, grads = self._jit_fused(arg_vals, aux_vals, rng, heads)
         for name in self._grad_names:
             g = grads[name]
             dst = self.grad_dict[name]

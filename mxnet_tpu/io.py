@@ -240,53 +240,113 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Background-thread prefetch over one or more iters
-    (reference: io.py PrefetchingIter / C++ PrefetcherIter,
-    src/io/iter_prefetcher.h)."""
+    """Background prefetch + device staging over one or more iterators.
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    Covers the reference PrefetcherIter capability
+    (src/io/iter_prefetcher.h) with a TPU-first design: each source
+    iterator is owned by a worker thread that feeds a bounded queue
+    (``prefetch_depth`` deep).  When ``ctx`` is given, the worker also
+    stages every batch's arrays onto that device, so the training loop
+    never blocks on the host→device transfer — the transfer of batch
+    k+1 overlaps the device compute of batch k.  Epochs are generation
+    numbers: ``reset()`` bumps the generation and workers abandon the
+    stale epoch; the consumer discards stale queue items.
+    """
+
+    _END = object()  # epoch-end marker
+    _ERR = object()  # worker-died marker (payload: the exception)
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 ctx=None, prefetch_depth=2):
         super().__init__()
+        import queue as _queue
+
         if not isinstance(iters, list):
             iters = [iters]
+        assert len(iters) > 0
         self.n_iter = len(iters)
-        assert self.n_iter > 0
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
+        self._ctx = ctx
         self.batch_size = self.provide_data[0][1][0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
-
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
-
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
+        self.current_batch = None
+        self._alive = True
+        self._gen = 0
+        self._epoch_done = False
+        self._queues = [_queue.Queue(maxsize=prefetch_depth)
+                        for _ in range(self.n_iter)]
+        self._epoch_go = [threading.Event() for _ in range(self.n_iter)]
+        for e in self._epoch_go:
+            e.set()  # produce the first epoch immediately
+        self._threads = [
+            threading.Thread(target=self._worker, args=(i,), daemon=True)
             for i in range(self.n_iter)]
-        for thread in self.prefetch_threads:
-            thread.start()
+        for t in self._threads:
+            t.start()
+
+    def _stage(self, batch: DataBatch) -> DataBatch:
+        if self._ctx is None:
+            return batch
+        import jax
+
+        dev = self._ctx.jax_device()
+
+        def put(arr):
+            if isinstance(arr, NDArray):
+                return NDArray(jax.device_put(arr._data, dev), self._ctx)
+            return NDArray(jax.device_put(np.asarray(arr), dev), self._ctx)
+
+        return DataBatch([put(d) for d in batch.data],
+                         [put(l) for l in (batch.label or [])],
+                         pad=batch.pad, index=batch.index,
+                         bucket_key=getattr(batch, "bucket_key", None),
+                         provide_data=batch.provide_data,
+                         provide_label=batch.provide_label)
+
+    def _worker(self, i):
+        q = self._queues[i]
+        it = self.iters[i]
+        first = True
+        while self._alive:
+            self._epoch_go[i].wait()
+            self._epoch_go[i].clear()
+            if not self._alive:
+                return
+            gen = self._gen
+            try:
+                if not first:
+                    it.reset()  # the worker owns its iterator
+                first = False
+                while self._alive and self._gen == gen:
+                    try:
+                        b = it.next()
+                    except StopIteration:
+                        break
+                    q.put((gen, self._stage(b)))
+                q.put((gen, PrefetchingIter._END))
+            except Exception as exc:  # surface staging/io errors, don't hang
+                q.put((gen, (PrefetchingIter._ERR, exc)))
+                return
+
+    def close(self):
+        """Stop the worker threads and drop queued batches."""
+        self._alive = False
+        self._gen += 1
+        for q in self._queues:
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except Exception:
+                    break
+        for e in self._epoch_go:
+            e.set()
+        for t in self._threads:
+            t.join(timeout=1.0)
 
     def __del__(self):
         try:
-            self.started = False
-            for e in self.data_taken:
-                e.set()
-            for thread in self.prefetch_threads:
-                thread.join(timeout=1.0)
+            self.close()
         except Exception:
             pass
 
@@ -307,34 +367,43 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
-        for i in self.iters:
-            i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
+        self._gen += 1
+        self._epoch_done = False
+        # unblock workers stuck on a full queue, discard stale items
+        for q in self._queues:
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except Exception:
+                    break
+        for e in self._epoch_go:
             e.set()
 
+    def _pop(self, i):
+        """Next item of the current generation from queue i (skips stale)."""
+        while True:
+            gen, item = self._queues[i].get()
+            if (isinstance(item, tuple) and len(item) == 2
+                    and item[0] is PrefetchingIter._ERR):
+                raise MXNetError(f"prefetch worker died: {item[1]!r}") from item[1]
+            if gen == self._gen:
+                return item
+
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
-            for i in self.next_batch:
-                assert i is None, "Number of entry mismatches between iterators"
+        if self._epoch_done:
+            return False  # stay at epoch end until reset() (never block)
+        items = [self._pop(i) for i in range(self.n_iter)]
+        ends = [it is PrefetchingIter._END for it in items]
+        if any(ends):
+            assert all(ends), "entry-count mismatch between prefetched iterators"
+            self._epoch_done = True
             return False
-        for batch in self.next_batch:
-            assert batch.pad == self.next_batch[0].pad, \
-                "Different pad number in the data batches"
+        for b in items:
+            assert b.pad == items[0].pad, "different pad in prefetched batches"
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([(batch.label or []) for batch in self.next_batch], []),
-            self.next_batch[0].pad,
-            self.next_batch[0].index)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+            sum([b.data for b in items], []),
+            sum([(b.label or []) for b in items], []),
+            pad=items[0].pad, index=items[0].index)
         return True
 
     def next(self):

@@ -74,7 +74,10 @@ class KVStore:
             if self._updater is not None:
                 self._updater(k, NDArray(merged), stored)
             else:
-                stored._set_data(stored._data + merged)
+                # no updater: store the merged value (reference
+                # kvstore_local.h:70 assigns local = merged, it does NOT
+                # accumulate into the stored weight)
+                stored._set_data(merged.astype(stored.dtype))
 
     def pull(self, key, out=None, priority=0):
         """Copy stored weight into out array(s) (reference: kvstore_local.h Pull)."""

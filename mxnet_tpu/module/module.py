@@ -318,6 +318,12 @@ class Module(BaseModule):
         if self._pending_batch is not None:
             self._run_fused_step()
             return
+        if self._fused_ready() and self._kvstore is None:
+            # batch was flushed through the plain path (get_outputs()
+            # before update()): apply its grads through the SAME fused
+            # optimizer state rather than a separate eager Updater
+            if self._update_with_fused_state():
+                return
         param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
         grad_arrays = [self._exec.grad_dict.get(n) for n in self._param_names]
         if self._update_on_kvstore:
@@ -333,7 +339,8 @@ class Module(BaseModule):
                 and not self._update_on_kvstore
                 and (self._kvstore is None or self._kvstore.type in ("tpu", "local", "device"))
                 and self._optimizer is not None
-                and hasattr(self._optimizer, "apply"))
+                and hasattr(self._optimizer, "apply")
+                and self._exec._outputs_all_loss_heads())
 
     def _build_fused_step(self):
         """One donated XLA program: forward + vjp + optimizer update.
@@ -352,7 +359,11 @@ class Module(BaseModule):
         lr_mult = {n: optimizer.lr_mult.get(n, 1.0) for n in pnames}
         wd_mult = {n: optimizer.wd_mult.get(n, 1.0) for n in pnames}
 
-        def step(params, fixed, aux, states, inputs, rng, lr, t):
+        def step(params, fixed, aux, states, inputs, key, lr, t):
+            # per-step PRNG derived on device from the base key + int32
+            # step counter — no per-step host→device key transfer
+            rng = jax.random.fold_in(key, t)
+
             def f(p):
                 full = dict(inputs)
                 full.update(fixed)
@@ -363,41 +374,126 @@ class Module(BaseModule):
             outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
             heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads = vjp_fn(heads)[0]
+            t_f = (t + 1).astype(jnp.float32)
             new_params = {}
             new_states = {}
             for n in pnames:
                 w, s = optimizer.apply(params[n], grads[n], states[n],
                                        lr * lr_mult[n],
-                                       optimizer.wd * wd_mult[n], t)
+                                       optimizer.wd * wd_mult[n], t_f)
                 new_params[n] = w
                 new_states[n] = s
-            return list(outs), new_params, new_aux, new_states
+            return list(outs), new_params, new_aux, new_states, t + 1
 
-        return jax.jit(step, donate_argnums=(0, 3))
+        return jax.jit(step, donate_argnums=(0, 3, 7))
+
+    def _ensure_fused_built(self, dev):
+        import jax
+        import jax.numpy as jnp
+
+        from .. import random as _random
+
+        if self._fused_step is not None:
+            return
+        self._grad_param_names = [n for n in self._param_names
+                                  if self._exec.grad_req.get(n, "null") != "null"]
+        self._fused_step = self._build_fused_step()
+        self._apply_grads = self._build_apply_grads()
+        self._fused_state = {
+            n: self._optimizer.init_state_arrays(self._exec.arg_dict[n]._data)
+            for n in self._grad_param_names}
+        # device-resident step counter + base PRNG key: donated and
+        # returned by the step so steady state does zero scalar
+        # host→device transfers
+        with jax.default_device(dev):
+            self._fused_t = jnp.int32(self._step_count)
+        self._fused_key = jax.device_put(_random.next_key(), dev)
+        self._lr_cache = {}
+
+    def _lr_device(self, dev):
+        """Device scalar for the current base lr, cached per value."""
+        import jax
+        import jax.numpy as jnp
+
+        lr = float(self._optimizer.lr_scheduler(self._optimizer.num_update)
+                   if self._optimizer.lr_scheduler else self._optimizer.lr)
+        lr_dev = self._lr_cache.get(lr)
+        if lr_dev is None:
+            if len(self._lr_cache) >= 64:
+                self._lr_cache.clear()  # per-step schedulers: don't leak
+            with jax.default_device(dev):
+                lr_dev = jnp.float32(lr)
+            self._lr_cache[lr] = lr_dev
+        return lr_dev
+
+    def _update_with_fused_state(self):
+        """Apply grad_dict gradients through the fused optimizer state
+        (the get_outputs()-fallback companion of _run_fused_step)."""
+        dev = self._context[0].jax_device()
+        self._ensure_fused_built(dev)
+        grads = {}
+        for n in self._grad_param_names:
+            g = self._exec.grad_dict.get(n)
+            if g is None:
+                return False
+            grads[n] = g._data
+        params = {n: self._exec.arg_dict[n]._data for n in self._grad_param_names}
+        self._step_count += 1
+        self._optimizer._update_count(0)
+        new_params, self._fused_state, self._fused_t = self._apply_grads(
+            params, grads, self._fused_state, self._lr_device(dev), self._fused_t)
+        for n, v in new_params.items():
+            self._exec.arg_dict[n]._set_data(v)
+        return True
+
+    def _build_apply_grads(self):
+        """Jitted optimizer-only program over the SAME fused state, used
+        when a batch was flushed through the plain executor path (e.g.
+        get_outputs() before update()) — keeps momentum/Adam state in one
+        place instead of diverging into an eager Updater."""
+        import jax
+        import jax.numpy as jnp
+
+        pnames = list(self._grad_param_names)
+        optimizer = self._optimizer
+        lr_mult = {n: optimizer.lr_mult.get(n, 1.0) for n in pnames}
+        wd_mult = {n: optimizer.wd_mult.get(n, 1.0) for n in pnames}
+
+        def apply_grads(params, grads, states, lr, t):
+            t_f = (t + 1).astype(jnp.float32)
+            new_params = {}
+            new_states = {}
+            for n in pnames:
+                w, s = optimizer.apply(params[n], grads[n], states[n],
+                                       lr * lr_mult[n],
+                                       optimizer.wd * wd_mult[n], t_f)
+                new_params[n] = w
+                new_states[n] = s
+            return new_params, new_states, t + 1
+
+        return jax.jit(apply_grads, donate_argnums=(0, 2, 4))
 
     def _run_fused_step(self):
+        import jax
         import jax.numpy as jnp
 
         from .. import random as _random
         from ..ndarray import NDArray
 
         inputs = {}
+        dev = self._context[0].jax_device()
         for k, v in self._pending_batch.items():
             arr = self._exec.arg_dict[k]
             if isinstance(v, NDArray):
-                arr._set_data(v._data.astype(arr.dtype))
+                # async host→device transfer straight to the target chip;
+                # overlaps with the still-running previous step
+                arr._set_data(jax.device_put(v._data.astype(arr.dtype), dev))
             else:
                 arr[:] = v
             inputs[k] = arr._data
         self._pending_batch = None
 
-        if self._fused_step is None:
-            self._grad_param_names = [n for n in self._param_names
-                                      if self._exec.grad_req.get(n, "null") != "null"]
-            self._fused_step = self._build_fused_step()
-            self._fused_state = {
-                n: self._optimizer.init_state_arrays(self._exec.arg_dict[n]._data)
-                for n in self._grad_param_names}
+        self._ensure_fused_built(dev)
 
         params = {n: self._exec.arg_dict[n]._data for n in self._grad_param_names}
         fixed = {n: self._exec.arg_dict[n]._data for n in self._param_names
@@ -405,13 +501,13 @@ class Module(BaseModule):
         aux = {n: a._data for n, a in self._exec.aux_dict.items()}
         self._step_count += 1
         self._optimizer._update_count(0)
-        # base lr; per-param lr_mult/wd_mult are folded inside the step
-        lr = (self._optimizer.lr_scheduler(self._optimizer.num_update)
-              if self._optimizer.lr_scheduler else self._optimizer.lr)
-        rng = _random.next_key()
-        outs, new_params, new_aux, new_states = self._fused_step(
-            params, fixed, aux, self._fused_state, inputs, rng,
-            jnp.float32(lr), jnp.float32(self._step_count))
+        # base lr; per-param lr_mult/wd_mult are folded inside the step.
+        # the device scalar is cached per distinct value (schedulers step
+        # it rarely relative to the step rate)
+        lr_dev = self._lr_device(dev)
+        outs, new_params, new_aux, new_states, self._fused_t = self._fused_step(
+            params, fixed, aux, self._fused_state, inputs, self._fused_key,
+            lr_dev, self._fused_t)
         for n, v in new_params.items():
             self._exec.arg_dict[n]._set_data(v)
         for n, v in new_aux.items():
@@ -422,10 +518,15 @@ class Module(BaseModule):
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
         if self._pending_batch is not None:
-            # outputs requested before update(): run the plain forward so
-            # the deferred-batch optimization stays invisible to callers
+            # outputs requested before update(): fall back to the plain
+            # forward+backward path for this batch so the deferred-batch
+            # optimization stays invisible — outputs and the gradients a
+            # later update() consumes come from the SAME program run
+            # (same dropout masks, aux updates applied exactly once)
             kwargs = self._pending_batch
+            self._pending_batch = None
             self._exec.forward(is_train=True, **kwargs)
+            self._exec.backward()
         return self._exec.outputs
 
     def get_input_grads(self, merge_multi_context=True):

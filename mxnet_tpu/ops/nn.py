@@ -602,6 +602,7 @@ def _softmax_output_fn(grad_scale, ignore_label, multi_output, use_ignore,
 
 
 @register("SoftmaxOutput", arg_names=("data", "label"), aliases=("Softmax",),
+          is_loss=True,
           doc="Softmax loss head; backward = (p - onehot)*scale ignoring head "
               "gradient (reference: softmax_output-inl.h)")
 def _softmax_output(op_ctx, attrs, inputs, aux):
@@ -663,7 +664,7 @@ def _make_regression(name, fwd_fn, grad_fn, ref):
             return in_shapes, [None], []
         return [tuple(d), tuple(d)], [tuple(d)], []
 
-    register(name, arg_names=("data", "label"), infer_shape=infer,
+    register(name, arg_names=("data", "label"), infer_shape=infer, is_loss=True,
              doc=f"{name} (reference: {ref})")(compute)
 
 
@@ -698,7 +699,7 @@ def _make_loss_fn(grad_scale, normalization, valid_thresh):
 
 
 @register("MakeLoss", arg_names=("data",), aliases=("make_loss",),
-          infer_shape=lambda attrs, s: (s, [s[0]], []),
+          infer_shape=lambda attrs, s: (s, [s[0]], []), is_loss=True,
           doc="Treat output as loss: backward = grad_scale (reference: make_loss-inl.h)")
 def _make_loss(op_ctx, attrs, inputs, aux):
     fn = _make_loss_fn(
@@ -738,7 +739,7 @@ def _svm_fn(margin, reg_coef, use_linear):
     return f
 
 
-@register("SVMOutput", arg_names=("data", "label"),
+@register("SVMOutput", arg_names=("data", "label"), is_loss=True,
           doc="SVM loss head (reference: svm_output-inl.h)")
 def _svm_output(op_ctx, attrs, inputs, aux):
     fn = _svm_fn(

@@ -70,6 +70,11 @@ class Optimizer:
         self.sym = sym
         self.lr_mult: Dict[str, float] = {}
         self.wd_mult: Dict[str, float] = {}
+        # apply the name-rule defaults (reference optimizer.py:79-80 calls
+        # set_lr_mult({})/set_wd_mult({}) from __init__: params not ending
+        # in _weight/_gamma get wd_mult=0), then symbol attrs override
+        self.set_lr_mult({})
+        self.set_wd_mult({})
         if sym is not None:
             attrs = sym.attr_dict()
             for name in sym.list_arguments():
