@@ -327,7 +327,8 @@ class PrefetchingIter(DataIter):
                 q.put((gen, PrefetchingIter._END))
             except Exception as exc:  # surface staging/io errors, don't hang
                 q.put((gen, (PrefetchingIter._ERR, exc)))
-                return
+                # stay alive: reset() can retry the epoch after the
+                # consumer has seen the error
 
     def close(self):
         """Stop the worker threads and drop queued batches."""

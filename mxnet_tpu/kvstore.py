@@ -140,12 +140,75 @@ class KVStore:
 
 class TPUKVStore(KVStore):
     """'tpu' flavor — the reference's 'device' reimagined on the ICI
-    mesh: aggregation happens on accelerator; when used through
-    Module/parallel, grads arrive already reduced by XLA collectives
-    so push degenerates to the updater call (SURVEY §5.8 mapping)."""
+    mesh (SURVEY §5.8): values live replicated/sharded on a
+    ``jax.sharding.Mesh`` and gradient aggregation is the XLA psum over
+    the 'dp' axis *inside* the fused training program, so there is no
+    push/pull traffic at all in the Module fast path.  ``mesh_plan``
+    (a ``mxnet_tpu.parallel.MeshPlan``) is attached by the Module that
+    activates it; the local push/pull API stays usable for tooling.
+    """
 
     def __init__(self, kv_type="tpu"):
         super().__init__(kv_type)
+        self.mesh_plan = None
+
+
+class DistKVStore(TPUKVStore):
+    """'dist_sync'/'dist_async' — multi-host over the JAX distributed
+    runtime (replaces ps-lite, kvstore_dist.h:28-318).
+
+    Processes are launched with the standard JAX multi-process env
+    (coordinator address + process id); ``jax.distributed.initialize``
+    wires DCN, ranks map to ``jax.process_index``, and the mesh spans
+    all hosts so the in-program psum rides ICI within a slice and DCN
+    across slices.  Barrier = a tiny all-device collective rendezvous.
+    """
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        import logging
+        import os
+
+        # wire the distributed runtime BEFORE any jax call that would
+        # initialize the XLA backend (jax.distributed.initialize must
+        # run first in the process); only attempted when the launcher
+        # configured the coordinator env
+        if "JAX_COORDINATOR_ADDRESS" in os.environ or \
+                "COORDINATOR_ADDRESS" in os.environ:
+            import jax
+
+            try:
+                jax.distributed.initialize()
+            except RuntimeError as exc:
+                if "already" in str(exc).lower():
+                    pass  # launcher/driver initialized it — fine
+                else:
+                    logging.warning(
+                        "kvstore %r: jax.distributed.initialize failed (%s); "
+                        "training will proceed SINGLE-PROCESS. Initialize the "
+                        "distributed runtime before creating jax arrays.",
+                        kv_type, exc)
+
+    def barrier(self):
+        """All-process rendezvous (reference: kvstore_dist.h Barrier →
+        ps::Postoffice barrier)."""
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")
+
+    def get_num_dead_node(self, node_id=0, timeout=0):
+        """JAX's coordinator fails collectives on peer loss rather than
+        heartbeating a count; report 0 while the runtime is healthy."""
+        import jax
+
+        try:
+            jax.process_count()
+            return 0
+        except Exception:
+            return 1
 
 
 def create(name="local") -> KVStore:
@@ -159,8 +222,7 @@ def create(name="local") -> KVStore:
     if name_l in ("tpu",):
         return TPUKVStore(name_l)
     if name_l.startswith("dist"):
-        kv = TPUKVStore(name_l)
-        return kv
+        return DistKVStore(name_l)
     raise MXNetError(f"unknown KVStore type {name!r}")
 
 

@@ -35,7 +35,9 @@ def _create_kvstore(kvstore, num_device, arg_params):
     elif isinstance(kvstore, kvs.KVStore):
         kv = kvstore
     elif isinstance(kvstore, str):
-        if num_device == 1 and "dist" not in kvstore:
+        # 'tpu' always creates (it activates the mesh even on one
+        # context); reference rule otherwise: single device local → None
+        if num_device == 1 and "dist" not in kvstore and kvstore != "tpu":
             kv = None
         else:
             kv = kvs.create(kvstore)
@@ -45,6 +47,10 @@ def _create_kvstore(kvstore, num_device, arg_params):
                     update_on_kvstore = False
     else:
         raise TypeError("kvstore must be KVStore, str or None")
+    if kv is not None and kv.type.startswith(("tpu", "dist")):
+        # mesh kvstores: the optimizer update runs inside the fused
+        # program (the sharded-update analogue of update_on_kvstore)
+        update_on_kvstore = False
     if kv is None:
         update_on_kvstore = False
     return kv, update_on_kvstore

@@ -83,6 +83,10 @@ class Module(BaseModule):
         self._fused_state = None
         self._pending_batch = None
         self._step_count = 0
+        self._flushed_backward = False
+        # mesh data/tensor parallelism (mxnet_tpu.parallel): activated by
+        # a multi-context list at bind or kvstore='tpu' at init_optimizer
+        self._mesh_plan = None
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -220,6 +224,16 @@ class Module(BaseModule):
         self._inferred_output_shapes = list(zip(self._output_names, out_shapes))
         self.binded = True
 
+        # multi-context == one mesh program with the batch sharded over
+        # 'dp' (replaces the reference's per-device executor group,
+        # executor_group.py:195-219)
+        if len(self._context) > 1 and self._mesh_plan is None:
+            from ..parallel import make_plan
+
+            self._mesh_plan = make_plan(self._context)
+        if self._mesh_plan is not None:
+            self._apply_mesh_plan()
+
         # restore cached params into the fresh executor (reference:
         # module.py bind copies _arg_params into the exec group)
         if self.params_initialized:
@@ -229,6 +243,31 @@ class Module(BaseModule):
 
         if shared_module is not None and shared_module.params_initialized:
             self.set_params(*shared_module.get_params())
+
+    def _apply_mesh_plan(self):
+        """Pin every executor array to its mesh placement: inputs batch-
+        sharded over 'dp', params/aux replicated unless a '__shard__'
+        symbol attr requests tensor-parallel sharding."""
+        plan = self._mesh_plan
+        attrs = self._symbol.attr_dict()
+        input_names = set(self._data_names) | set(self._label_names)
+        for name, shapes in (self._data_shapes or []):
+            plan.check_batch(shapes[plan.batch_axis] if shapes else 0)
+        for name, arr in self._exec.arg_dict.items():
+            if name in input_names:
+                sh = plan.input_sharding(arr.ndim)
+            else:
+                sh = plan.param_sharding(arr.ndim,
+                                         attrs.get(name, {}).get("__shard__"))
+            arr._sharding = sh
+            arr._set_data(arr._data)  # re-place via the sharding pin
+            g = self._exec.grad_dict.get(name)
+            if g is not None:
+                g._sharding = sh
+                g._set_data(g._data)
+        for name, arr in self._exec.aux_dict.items():
+            arr._sharding = plan.replicated()
+            arr._set_data(arr._data)
 
     # ------------------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -242,6 +281,19 @@ class Module(BaseModule):
         arg_params = {n: self._exec.arg_dict[n] for n in self._param_names}
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), arg_params)
+
+        # kvstore='tpu': data parallelism over the whole visible mesh
+        # (or the context list), gradients reduced by XLA collectives
+        # inside the fused program — SURVEY §5.8 mapping
+        if kvstore is not None and kvstore.type.startswith(("tpu", "dist")) \
+                and self._mesh_plan is None:
+            from ..parallel import make_plan
+
+            self._mesh_plan = make_plan(
+                self._context if len(self._context) > 1 else None)
+            self._apply_mesh_plan()
+        if kvstore is not None and self._mesh_plan is not None:
+            kvstore.mesh_plan = self._mesh_plan
 
         if isinstance(optimizer, str):
             batch_size = self._data_shapes[0][1][0]
@@ -284,6 +336,7 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if is_train is None:
             is_train = self.for_training
+        self._flushed_backward = False
         kwargs = {}
         for name, arr in zip(self._data_names, data_batch.data):
             kwargs[name] = arr
@@ -302,6 +355,11 @@ class Module(BaseModule):
             if out_grads is None:
                 return  # handled by the fused step in update()
             self._flush_pending()  # explicit head grads need the plain path
+        if self._flushed_backward and out_grads is None:
+            # get_outputs() already ran backward for this batch — don't
+            # write (or with grad_req='add', accumulate) the grads twice
+            self._flushed_backward = False
+            return
         self._exec.backward(out_grads=out_grads)
 
     def _flush_pending(self):
@@ -318,7 +376,8 @@ class Module(BaseModule):
         if self._pending_batch is not None:
             self._run_fused_step()
             return
-        if self._fused_ready() and self._kvstore is None:
+        if self._fused_ready() and (self._kvstore is None
+                                    or self._mesh_plan is not None):
             # batch was flushed through the plain path (get_outputs()
             # before update()): apply its grads through the SAME fused
             # optimizer state rather than a separate eager Updater
@@ -337,7 +396,9 @@ class Module(BaseModule):
         return (self._use_fused and self.optimizer_initialized
                 and not self.inputs_need_grad
                 and not self._update_on_kvstore
-                and (self._kvstore is None or self._kvstore.type in ("tpu", "local", "device"))
+                and (self._kvstore is None
+                     or self._kvstore.type in ("tpu", "local", "device")
+                     or self._kvstore.type.startswith("dist"))
                 and self._optimizer is not None
                 and hasattr(self._optimizer, "apply")
                 and self._exec._outputs_all_loss_heads())
@@ -404,10 +465,15 @@ class Module(BaseModule):
             for n in self._grad_param_names}
         # device-resident step counter + base PRNG key: donated and
         # returned by the step so steady state does zero scalar
-        # host→device transfers
-        with jax.default_device(dev):
-            self._fused_t = jnp.int32(self._step_count)
-        self._fused_key = jax.device_put(_random.next_key(), dev)
+        # host→device transfers.  On a mesh they live replicated.
+        if self._mesh_plan is not None:
+            rep = self._mesh_plan.replicated()
+            self._fused_t = jax.device_put(np.int32(self._step_count), rep)
+            self._fused_key = jax.device_put(_random.next_key(), rep)
+        else:
+            with jax.default_device(dev):
+                self._fused_t = jnp.int32(self._step_count)
+            self._fused_key = jax.device_put(_random.next_key(), dev)
         self._lr_cache = {}
 
     def _lr_device(self, dev):
@@ -421,8 +487,12 @@ class Module(BaseModule):
         if lr_dev is None:
             if len(self._lr_cache) >= 64:
                 self._lr_cache.clear()  # per-step schedulers: don't leak
-            with jax.default_device(dev):
-                lr_dev = jnp.float32(lr)
+            if self._mesh_plan is not None:
+                lr_dev = jax.device_put(np.float32(lr),
+                                        self._mesh_plan.replicated())
+            else:
+                with jax.default_device(dev):
+                    lr_dev = jnp.float32(lr)
             self._lr_cache[lr] = lr_dev
         return lr_dev
 
@@ -485,9 +555,13 @@ class Module(BaseModule):
         for k, v in self._pending_batch.items():
             arr = self._exec.arg_dict[k]
             if isinstance(v, NDArray):
-                # async host→device transfer straight to the target chip;
-                # overlaps with the still-running previous step
-                arr._set_data(jax.device_put(v._data.astype(arr.dtype), dev))
+                if arr._sharding is not None:
+                    # _set_data re-places onto the batch-sharded mesh layout
+                    arr._set_data(v._data.astype(arr.dtype))
+                else:
+                    # async host→device transfer straight to the target
+                    # chip; overlaps with the still-running previous step
+                    arr._set_data(jax.device_put(v._data.astype(arr.dtype), dev))
             else:
                 arr[:] = v
             inputs[k] = arr._data
@@ -527,6 +601,7 @@ class Module(BaseModule):
             self._pending_batch = None
             self._exec.forward(is_train=True, **kwargs)
             self._exec.backward()
+            self._flushed_backward = True
         return self._exec.outputs
 
     def get_input_grads(self, merge_multi_context=True):

@@ -1,0 +1,149 @@
+"""Mesh parallelism — the TPU-native multi-device layer.
+
+This module replaces the reference's entire multi-device machinery with
+one idea: a ``jax.sharding.Mesh`` + ``NamedSharding`` annotations on
+the arrays of the ONE fused training program, letting XLA insert the
+collectives the reference performed by hand:
+
+reference capability                         → here
+-------------------------------------------------------------------
+DataParallelExecutorGroup.decide_slices        batch dim sharded over
+  (python/mxnet/module/executor_group.py:195)  the 'dp' mesh axis
+KVStoreLocal/CommDevice gradient reduce        psum over 'dp' inserted
+  (src/kvstore/comm.h:200-360)                 by XLA from the vjp of
+                                               the broadcast params
+ctx_group / group2ctx model parallelism        per-parameter
+  (src/executor/graph_executor.cc:301)         PartitionSpec from the
+                                               '__shard__' symbol attr
+ps-lite multi-host (src/kvstore/kvstore_dist.h) jax.distributed runtime
+                                               + DCN collectives
+
+A parameter opts into tensor/model parallelism by carrying a
+``__shard__`` attribute of the form ``"axis:dim"`` (e.g. ``"tp:0"``
+shards dim 0 over the 'tp' mesh axis); everything else is replicated.
+Inputs are sharded on the batch dimension over 'dp'.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context
+
+__all__ = ["MeshPlan", "make_plan", "shard_attr"]
+
+
+def shard_attr(axis: str, dim: int = 0) -> Dict[str, str]:
+    """Attr dict marking a Variable for tensor-parallel sharding:
+    ``mx.sym.Variable('w', attr=parallel.shard_attr('tp', 0))``."""
+    return {"__shard__": f"{axis}:{dim}"}
+
+
+def annotate_shard(symbol, arg_name: str, axis: str, dim: int = 0):
+    """Mark an existing argument of a built symbol for sharding (the
+    post-hoc form of ``shard_attr`` for model-zoo graphs)."""
+    for n in symbol._topo():
+        if n.is_variable and n.name == arg_name:
+            n._meta["__shard__"] = f"{axis}:{dim}"
+            return symbol
+    raise MXNetError(f"argument {arg_name!r} not found in symbol")
+
+
+class MeshPlan:
+    """A device mesh + the sharding rules for one Module's program."""
+
+    def __init__(self, devices: Sequence, dp: Optional[int] = None, tp: int = 1,
+                 batch_axis: int = 0):
+        import jax
+        from jax.sharding import Mesh
+
+        n = len(devices)
+        if dp is None:
+            if n % tp != 0:
+                raise MXNetError(f"{n} devices not divisible by tp={tp}")
+            dp = n // tp
+        if dp * tp != n:
+            raise MXNetError(f"dp({dp}) * tp({tp}) != devices({n})")
+        self.dp = dp
+        self.tp = tp
+        self.batch_axis = batch_axis
+        self.devices = list(devices)
+        self.mesh = Mesh(np.asarray(self.devices).reshape(dp, tp), ("dp", "tp"))
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp
+
+    # -- shardings ------------------------------------------------------
+    def _named(self, spec):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self):
+        from jax.sharding import PartitionSpec as P
+
+        return self._named(P())
+
+    def input_sharding(self, ndim: int):
+        """Batch dim sharded over 'dp', everything else replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        spec = [None] * ndim
+        if ndim > 0:
+            spec[self.batch_axis] = "dp"
+        return self._named(P(*spec))
+
+    def param_sharding(self, ndim: int, attr: Optional[str] = None):
+        """Replicated unless a '__shard__' attr ("axis:dim") says else."""
+        from jax.sharding import PartitionSpec as P
+
+        if not attr:
+            return self.replicated()
+        try:
+            axis, dim_s = attr.split(":")
+            dim = int(dim_s)
+        except ValueError:
+            raise MXNetError(f"bad __shard__ attr {attr!r}; want 'axis:dim'")
+        if axis not in ("dp", "tp"):
+            raise MXNetError(f"unknown mesh axis {axis!r} in __shard__ attr")
+        if dim >= ndim:
+            raise MXNetError(f"__shard__ dim {dim} out of range for ndim {ndim}")
+        spec = [None] * ndim
+        spec[dim] = axis
+        return self._named(P(*spec))
+
+    # -- placement ------------------------------------------------------
+    def place(self, value, sharding):
+        """device_put a host or device array onto the mesh placement."""
+        import jax
+
+        return jax.device_put(value, sharding)
+
+    def check_batch(self, batch_size: int):
+        if batch_size % self.dp != 0:
+            raise MXNetError(
+                f"batch size {batch_size} not divisible by dp={self.dp}")
+
+
+def make_plan(contexts: Optional[Sequence[Context]] = None, tp: int = 1,
+              batch_axis: int = 0) -> MeshPlan:
+    """Build a MeshPlan from Module contexts (or every visible device).
+
+    With a context list, each context resolves to its jax device (the
+    multi-GPU ``Module(context=[...])`` idiom); with none, all devices
+    of the default accelerator platform form the mesh (``kvstore='tpu'``
+    idiom).
+    """
+    import jax
+
+    if contexts:
+        devices = [c.jax_device() for c in contexts]
+        if len(set(devices)) != len(devices):
+            raise MXNetError("duplicate devices in context list")
+    else:
+        devices = jax.devices()
+    return MeshPlan(devices, tp=tp, batch_axis=batch_axis)
