@@ -56,6 +56,10 @@ class Initializer:
             self._init_beta(name, arr)
         elif name.endswith("weight"):
             self._init_weight(name, arr)
+        elif name.endswith("parameters"):
+            # fused RNN packed parameter vector ({name}_parameters,
+            # rnn-inl.h); weight-like init over the flat vector
+            self._init_weight(name, arr)
         elif name.endswith("moving_mean") or name.endswith("running_mean"):
             self._init_zero(name, arr)
         elif name.endswith("moving_var") or name.endswith("running_var"):

@@ -1,0 +1,248 @@
+"""Fused RNN operator (modes rnn_relu / rnn_tanh / lstm / gru).
+
+Covers the reference's cuDNN-backed ``RNN`` op
+(``src/operator/rnn-inl.h:24-70``; GPU-only there — the CPU forward is
+``LOG(FATAL)``) with a TPU-native design:
+
+* the input projection ``x @ W^T`` for the WHOLE sequence is one big
+  batched matmul (MXU-friendly, [T*B, I] x [I, G*H]);
+* only the recurrent part runs under ``lax.scan`` — the per-step work
+  is a single [B,H] x [H,G*H] matmul plus elementwise gate math, which
+  XLA fuses;
+* multi-layer and bidirectional stack as python loops over scans
+  (static, unrolled at trace time);
+* gradients come from JAX's scan autodiff — no hand-written backward.
+
+Parameter packing (size formula identical to rnn-inl.h:31-70:
+``H*(H+I+2)*G`` per layer/direction): for each layer, for each
+direction: W [G*H, I_l] then U [G*H, H]; after ALL weight blocks, for
+each layer/direction: b_W [G*H] then b_U [G*H].  Gate order: LSTM
+i,f,g,o; GRU r,z,n (the cuDNN convention the reference inherits).
+
+Inputs: data [T,B,I] (time-major, MXNet 'TNC'), parameters (packed 1D),
+state [L*D,B,H], state_cell [L*D,B,H] (lstm only).
+Outputs: output [T,B,H*D] (+ state_output / statecell_output when
+``state_outputs=True``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, attr_bool, attr_float, attr_int
+from .registry import register, get_op
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers: int, input_size: int, state_size: int,
+                   bidirectional: bool, mode: str) -> int:
+    """Packed parameter count (reference: rnn-inl.h:31-70)."""
+    g = _GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    size = h * (h + input_size + 2) * g
+    if num_layers > 1:
+        size += (num_layers - 1) * h * (h + d * h + 2) * g
+    return size * d
+
+
+def _unpack_params(params, num_layers, input_size, h, d, g):
+    """Split the flat parameter vector into per-(layer,dir) W,U,bW,bU."""
+    weights = []
+    off = 0
+    for layer in range(num_layers):
+        i_l = input_size if layer == 0 else h * d
+        per_dir = []
+        for _ in range(d):
+            w = params[off:off + g * h * i_l].reshape(g * h, i_l)
+            off += g * h * i_l
+            u = params[off:off + g * h * h].reshape(g * h, h)
+            off += g * h * h
+            per_dir.append([w, u])
+        weights.append(per_dir)
+    for layer in range(num_layers):
+        for dd in range(d):
+            bw = params[off:off + g * h]
+            off += g * h
+            bu = params[off:off + g * h]
+            off += g * h
+            weights[layer][dd].extend([bw, bu])
+    return weights
+
+
+def _cell_step(mode, h_size):
+    """Returns fn(carry, gates_preact) -> (carry, out_h)."""
+    if mode == "rnn_relu":
+        def step(carry, pre):
+            h = jax.nn.relu(pre)
+            return (h,), h
+    elif mode == "rnn_tanh":
+        def step(carry, pre):
+            h = jnp.tanh(pre)
+            return (h,), h
+    elif mode == "lstm":
+        def step(carry, pre):
+            h_prev, c_prev = carry
+            i, f, gte, o = jnp.split(pre, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            gte = jnp.tanh(gte)
+            o = jax.nn.sigmoid(o)
+            c = f * c_prev + i * gte
+            h = o * jnp.tanh(c)
+            return (h, c), h
+    else:
+        raise MXNetError(f"unhandled rnn cell mode {mode}")
+    return step
+
+
+def _scan_direction(x, h0, c0, w, u, bw, bu, mode, reverse):
+    """One (layer, direction) scan.  x: [T,B,I]; returns y [T,B,H]."""
+    h = h0.shape[-1]
+
+    if mode == "gru":
+        # GRU's reset gate multiplies the candidate's recurrent
+        # projection, so U stays inside the step (cuDNN formula:
+        # n = tanh(W_n x + b_Wn + r * (U_n h + b_Un)))
+        xw = jnp.einsum("tbi,gi->tbg", x, w) + bw
+        u_r, u_z, u_n = jnp.split(u, 3, axis=0)
+        b_r, b_z, b_n = jnp.split(bu, 3)
+
+        def gru_step(carry, x_t):
+            (h_prev,) = carry
+            x_r, x_z, x_n = jnp.split(x_t, 3, axis=-1)
+            r = jax.nn.sigmoid(x_r + h_prev @ u_r.T + b_r)
+            z = jax.nn.sigmoid(x_z + h_prev @ u_z.T + b_z)
+            n = jnp.tanh(x_n + r * (h_prev @ u_n.T + b_n))
+            h_new = (1 - z) * n + z * h_prev
+            return (h_new,), h_new
+
+        (hT,), y = jax.lax.scan(gru_step, (h0,), xw, reverse=reverse)
+        return y, hT, None
+
+    # whole-sequence input projection on the MXU
+    xw = jnp.einsum("tbi,gi->tbg", x, w) + bw + bu
+    cell = _cell_step(mode, h)
+
+    def scan_fn(carry, x_t):
+        pre = x_t + carry[0] @ u.T
+        new_carry, y = cell(carry, pre)
+        return new_carry, y
+
+    carry0 = (h0, c0) if mode == "lstm" else (h0,)
+    carryT, y = jax.lax.scan(scan_fn, carry0, xw, reverse=reverse)
+    hT = carryT[0]
+    cT = carryT[1] if mode == "lstm" else None
+    return y, hT, cT
+
+
+def _rnn_forward(data, params, state, state_cell, attrs, op_ctx):
+    h = attr_int(attrs["state_size"])
+    num_layers = attr_int(attrs["num_layers"])
+    bidirectional = attr_bool(attrs.get("bidirectional"), False)
+    mode = attrs["mode"]
+    p_drop = attr_float(attrs.get("p", 0.0), 0.0)
+    d = 2 if bidirectional else 1
+    g = _GATES[mode]
+    t, b, input_size = data.shape
+
+    weights = _unpack_params(params, num_layers, input_size, h, d, g)
+    state = state.reshape(num_layers, d, b, h)
+    cell = state_cell.reshape(num_layers, d, b, h) if state_cell is not None else None
+
+    x = data
+    h_finals = []
+    c_finals = []
+    for layer in range(num_layers):
+        ys = []
+        for dd in range(d):
+            w, u, bw, bu = weights[layer][dd]
+            y, hT, cT = _scan_direction(
+                x, state[layer, dd],
+                cell[layer, dd] if cell is not None else None,
+                w, u, bw, bu, mode, reverse=(dd == 1))
+            ys.append(y)
+            h_finals.append(hT)
+            if cT is not None:
+                c_finals.append(cT)
+        x = ys[0] if d == 1 else jnp.concatenate(ys, axis=-1)
+        if p_drop > 0.0 and op_ctx.is_train and layer < num_layers - 1 \
+                and op_ctx.rng is not None:
+            keep = 1.0 - p_drop
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(op_ctx.rng, layer), keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    h_out = jnp.stack(h_finals).reshape(num_layers * d, b, h)
+    c_out = (jnp.stack(c_finals).reshape(num_layers * d, b, h)
+             if c_finals else None)
+    return x, h_out, c_out
+
+
+def _rnn_args(attrs):
+    if attrs.get("mode") == "lstm":
+        return ["data", "parameters", "state", "state_cell"]
+    return ["data", "parameters", "state"]
+
+
+def _rnn_outs(attrs):
+    outs = ["output"]
+    if attr_bool(attrs.get("state_outputs"), False):
+        outs.append("state_output")
+        if attrs.get("mode") == "lstm":
+            outs.append("statecell_output")
+    return outs
+
+
+@register("RNN", arg_names=_rnn_args, out_names=_rnn_outs, needs_rng=True,
+          doc="Fused multi-layer (bi)directional RNN/LSTM/GRU over "
+              "lax.scan (reference: rnn-inl.h, cudnn_rnn-inl.h)")
+def _rnn(op_ctx, attrs, inputs, aux):
+    mode = attrs.get("mode")
+    if mode not in _GATES:
+        raise MXNetError(f"RNN mode {mode!r} not in {sorted(_GATES)}")
+    data = inputs[0]
+    params = inputs[1]
+    state = inputs[2]
+    state_cell = inputs[3] if mode == "lstm" else None
+    out, h_out, c_out = _rnn_forward(data, params, state, state_cell,
+                                     attrs, op_ctx)
+    outs = [out]
+    if attr_bool(attrs.get("state_outputs"), False):
+        outs.append(h_out)
+        if mode == "lstm":
+            outs.append(c_out)
+    return outs
+
+
+def _rnn_infer(attrs, in_shapes):
+    h = attr_int(attrs["state_size"])
+    num_layers = attr_int(attrs["num_layers"])
+    bidirectional = attr_bool(attrs.get("bidirectional"), False)
+    mode = attrs["mode"]
+    d = 2 if bidirectional else 1
+    data = in_shapes[0]
+    if data is None:
+        return in_shapes, [None] * len(_rnn_outs(attrs)), []
+    if len(data) != 3:
+        raise MXNetError(f"RNN data must be [seq_len, batch, input]; got {data}")
+    t, b, i = data
+    n_params = rnn_param_size(num_layers, i, h, bidirectional, mode)
+    state_shape = (num_layers * d, b, h)
+    in_out = [tuple(data), (n_params,), state_shape]
+    if mode == "lstm":
+        in_out.append(state_shape)
+    outs = [(t, b, h * d)]
+    if attr_bool(attrs.get("state_outputs"), False):
+        outs.append(state_shape)
+        if mode == "lstm":
+            outs.append(state_shape)
+    return in_out, outs, []
+
+
+get_op("RNN").infer_shape = _rnn_infer

@@ -1,0 +1,221 @@
+"""Fused RNN op tests — numpy references per mode, shapes, gradients.
+
+Mirrors the reference's operator test style (forward vs inline numpy,
+finite-difference backward — tests/python/unittest/test_operator.py).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.rnn import rnn_param_size, _GATES
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _unpack_np(params, L, I, H, D, G):
+    ws = []
+    off = 0
+    for layer in range(L):
+        i_l = I if layer == 0 else H * D
+        per = []
+        for _ in range(D):
+            w = params[off:off + G * H * i_l].reshape(G * H, i_l); off += G * H * i_l
+            u = params[off:off + G * H * H].reshape(G * H, H); off += G * H * H
+            per.append([w, u])
+        ws.append(per)
+    for layer in range(L):
+        for dd in range(D):
+            ws[layer][dd].append(params[off:off + G * H]); off += G * H
+            ws[layer][dd].append(params[off:off + G * H]); off += G * H
+    assert off == params.size
+    return ws
+
+
+def _np_lstm_layer(x, h0, c0, w, u, bw, bu, reverse=False):
+    T, B, _ = x.shape
+    H = h0.shape[-1]
+    h, c = h0.copy(), c0.copy()
+    ys = np.zeros((T, B, H), np.float64)
+    ts = range(T - 1, -1, -1) if reverse else range(T)
+    for t in ts:
+        pre = x[t] @ w.T + h @ u.T + bw + bu
+        i, f, g, o = np.split(pre, 4, axis=-1)
+        c = _sigmoid(f) * c + _sigmoid(i) * np.tanh(g)
+        h = _sigmoid(o) * np.tanh(c)
+        ys[t] = h
+    return ys, h, c
+
+
+def _np_gru_layer(x, h0, w, u, bw, bu, reverse=False):
+    T, B, _ = x.shape
+    H = h0.shape[-1]
+    h = h0.copy()
+    u_r, u_z, u_n = np.split(u, 3, axis=0)
+    b_r, b_z, b_n = np.split(bu, 3)
+    ys = np.zeros((T, B, H), np.float64)
+    ts = range(T - 1, -1, -1) if reverse else range(T)
+    for t in ts:
+        xp = x[t] @ w.T + bw
+        x_r, x_z, x_n = np.split(xp, 3, axis=-1)
+        r = _sigmoid(x_r + h @ u_r.T + b_r)
+        z = _sigmoid(x_z + h @ u_z.T + b_z)
+        n = np.tanh(x_n + r * (h @ u_n.T + b_n))
+        h = (1 - z) * n + z * h
+        ys[t] = h
+    return ys, h
+
+
+def _bind_rnn(T, B, I, H, L, mode, bidirectional=False, state_outputs=True):
+    data = mx.sym.Variable("data")
+    kwargs = dict(state_size=H, num_layers=L, mode=mode,
+                  bidirectional=bidirectional, state_outputs=state_outputs,
+                  name="rnn")
+    if mode == "lstm":
+        rnn = mx.sym.RNN(data=data, parameters=mx.sym.Variable("p"),
+                         state=mx.sym.Variable("s"),
+                         state_cell=mx.sym.Variable("c"), **kwargs)
+    else:
+        rnn = mx.sym.RNN(data=data, parameters=mx.sym.Variable("p"),
+                         state=mx.sym.Variable("s"), **kwargs)
+    return rnn.simple_bind(mx.cpu(), data=(T, B, I))
+
+
+def test_param_size_matches_reference_formula():
+    # reference rnn-inl.h:31-70 worked examples
+    assert rnn_param_size(1, 4, 6, False, "lstm") == 6 * (6 + 4 + 2) * 4
+    assert rnn_param_size(2, 4, 6, False, "gru") == \
+        (6 * (6 + 4 + 2) + 6 * (6 + 6 + 2)) * 3
+    assert rnn_param_size(2, 4, 6, True, "rnn_tanh") == \
+        (6 * (6 + 4 + 2) + 6 * (6 + 12 + 2)) * 2
+
+
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh", "rnn_relu"])
+def test_rnn_forward_matches_numpy(mode):
+    T, B, I, H, L = 4, 2, 3, 5, 2
+    G = _GATES[mode]
+    rng = np.random.RandomState(7)
+    n = rnn_param_size(L, I, H, False, mode)
+    params = (rng.randn(n) * 0.2).astype(np.float32)
+    x = rng.randn(T, B, I).astype(np.float32)
+    h0 = rng.randn(L, B, H).astype(np.float32) * 0.1
+    c0 = rng.randn(L, B, H).astype(np.float32) * 0.1
+
+    ex = _bind_rnn(T, B, I, H, L, mode)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["p"][:] = params
+    ex.arg_dict["s"][:] = h0
+    if mode == "lstm":
+        ex.arg_dict["c"][:] = c0
+    ex.forward(is_train=False)
+    got = [o.asnumpy() for o in ex.outputs]
+
+    ws = _unpack_np(params.astype(np.float64), L, I, H, 1, G)
+    xx = x.astype(np.float64)
+    hs, cs = [], []
+    for layer in range(L):
+        w, u, bw, bu = ws[layer][0]
+        if mode == "lstm":
+            xx, hT, cT = _np_lstm_layer(xx, h0[layer].astype(np.float64),
+                                        c0[layer].astype(np.float64), w, u, bw, bu)
+            cs.append(cT)
+        elif mode == "gru":
+            xx, hT = _np_gru_layer(xx, h0[layer].astype(np.float64), w, u, bw, bu)
+        else:
+            act = np.tanh if mode == "rnn_tanh" else lambda v: np.maximum(v, 0)
+            h = h0[layer].astype(np.float64).copy()
+            ys = np.zeros((T, B, H))
+            for t in range(T):
+                h = act(xx[t] @ w.T + h @ u.T + bw + bu)
+                ys[t] = h
+            xx, hT = ys, h
+        hs.append(hT)
+    np.testing.assert_allclose(got[0], xx, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got[1], np.stack(hs), rtol=1e-4, atol=1e-5)
+    if mode == "lstm":
+        np.testing.assert_allclose(got[2], np.stack(cs), rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_bidirectional_matches_numpy():
+    T, B, I, H = 4, 2, 3, 5
+    rng = np.random.RandomState(3)
+    n = rnn_param_size(1, I, H, True, "lstm")
+    params = (rng.randn(n) * 0.2).astype(np.float32)
+    x = rng.randn(T, B, I).astype(np.float32)
+    h0 = np.zeros((2, B, H), np.float32)
+    c0 = np.zeros((2, B, H), np.float32)
+
+    ex = _bind_rnn(T, B, I, H, 1, "lstm", bidirectional=True)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["p"][:] = params
+    ex.forward(is_train=False)
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (T, B, 2 * H)
+
+    ws = _unpack_np(params.astype(np.float64), 1, I, H, 2, 4)
+    xx = x.astype(np.float64)
+    y_f, _, _ = _np_lstm_layer(xx, h0[0].astype(np.float64),
+                               c0[0].astype(np.float64), *ws[0][0])
+    y_b, _, _ = _np_lstm_layer(xx, h0[1].astype(np.float64),
+                               c0[1].astype(np.float64), *ws[0][1], reverse=True)
+    np.testing.assert_allclose(out, np.concatenate([y_f, y_b], -1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_gradient():
+    """Train a tiny LSTM regressor; loss must drop (end-to-end grad path)."""
+    T, B, I, H = 6, 8, 4, 8
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, T, I).astype(np.float32)
+    # predictable target: sum over time of first input dim
+    Y = X[:, :, 0].sum(axis=1)
+
+    data = mx.sym.Variable("data")
+    tnc = mx.sym.transpose(data, axes=(1, 0, 2), name="tnc")
+    rnn = mx.sym.RNN(data=tnc, parameters=mx.sym.Variable("rnn_parameters"),
+                     state=mx.sym.Variable("rnn_s"),
+                     state_cell=mx.sym.Variable("rnn_c"),
+                     state_size=H, num_layers=1, mode="lstm", name="rnn")
+    last = mx.sym.SequenceLast(rnn, name="last")
+    pred = mx.sym.FullyConnected(last, num_hidden=1, name="pred")
+    net = mx.sym.LinearRegressionOutput(mx.sym.Reshape(pred, shape=(-1,)),
+                                        name="lro")
+
+    it = mx.io.NDArrayIter(X, Y, batch_size=16, label_name="lro_label")
+    mod = mx.mod.Module(net, context=mx.cpu(), label_names=("lro_label",))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mx.random.seed(5)
+    zeros_s = mx.nd.zeros((1, 16, H))
+    mod.init_params(mx.initializer.Uniform(0.08),
+                    arg_params={"rnn_s": zeros_s, "rnn_c": zeros_s.copy()})
+    mod.init_optimizer(kvstore=None, optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    losses = []
+    for epoch in range(15):
+        it.reset()
+        mse = 0.0
+        n = 0
+        for b in it:
+            mod.forward_backward(b)
+            mod.update()
+            out = mod.get_outputs()[0].asnumpy()
+            mse += float(((out - b.label[0].asnumpy()) ** 2).sum())
+            n += out.shape[0]
+        losses.append(mse / n)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_rnn_dropout_train_vs_eval():
+    T, B, I, H, L = 4, 2, 3, 5, 2
+    rng = np.random.RandomState(1)
+    n = rnn_param_size(L, I, H, False, "lstm")
+    ex = _bind_rnn(T, B, I, H, L, "lstm", state_outputs=False)
+    ex.arg_dict["data"][:] = rng.randn(T, B, I).astype(np.float32)
+    ex.arg_dict["p"][:] = (rng.randn(n) * 0.2).astype(np.float32)
+    # p only affects train mode; eval must be deterministic
+    o1 = ex.forward(is_train=False)[0].asnumpy()
+    o2 = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(o1, o2)
