@@ -33,6 +33,51 @@ from .base_module import BaseModule, _as_list
 __all__ = ["Module"]
 
 
+def _buffer_ids(*trees):
+    """Set of id()s of every jax.Array leaf in the given pytrees."""
+    import jax
+
+    out = set()
+    for t in trees:
+        for leaf in jax.tree_util.tree_leaves(t):
+            if isinstance(leaf, jax.Array):
+                out.add(id(leaf))
+    return out
+
+
+def _copy_donated_aliases(params, protected_ids):
+    """Materialize a copy of any param leaf whose buffer is passed to the
+    fused program more than once — as another donated param or as any
+    non-donated argument (fixed/aux/input/state).
+
+    Donating an aliased buffer either fails ("Attempt to donate the
+    same buffer twice") or deletes a buffer another argument still
+    reads.  Aliased param buffers are possible here (e.g. arg_params
+    initialized from one array, or user ``_set_data`` sharing); after
+    the copy the names train as independent parameters — same semantics
+    as the reference, where distinct named params own distinct storage
+    (tying is expressed by reusing one Variable in the symbol, not by
+    aliasing two params' buffers).
+
+    Only ``params`` is scanned per step: optimizer state trees are
+    framework-allocated with distinct buffers (see init_state_arrays)
+    and in steady state are fresh outputs of the previous donated call.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    seen = set()
+
+    def fix(x):
+        if isinstance(x, jax.Array):
+            if id(x) in seen or id(x) in protected_ids:
+                return jnp.array(x, copy=True)
+            seen.add(id(x))
+        return x
+
+    return jax.tree_util.tree_map(fix, params)
+
+
 class Module(BaseModule):
     """reference: module.py Module"""
 
@@ -510,8 +555,11 @@ class Module(BaseModule):
         params = {n: self._exec.arg_dict[n]._data for n in self._grad_param_names}
         self._step_count += 1
         self._optimizer._update_count(0)
+        params = _copy_donated_aliases(
+            params, _buffer_ids(grads, self._fused_state, self._fused_t))
         new_params, self._fused_state, self._fused_t = self._apply_grads(
-            params, grads, self._fused_state, self._lr_device(dev), self._fused_t)
+            params, grads, self._fused_state, self._lr_device(dev),
+            self._fused_t)
         for n, v in new_params.items():
             self._exec.arg_dict[n]._set_data(v)
         return True
@@ -579,6 +627,9 @@ class Module(BaseModule):
         # the device scalar is cached per distinct value (schedulers step
         # it rarely relative to the step rate)
         lr_dev = self._lr_device(dev)
+        params = _copy_donated_aliases(
+            params, _buffer_ids(fixed, aux, inputs, self._fused_state,
+                                self._fused_key, self._fused_t))
         outs, new_params, new_aux, new_states, self._fused_t = self._fused_step(
             params, fixed, aux, self._fused_state, inputs, self._fused_key,
             lr_dev, self._fused_t)

@@ -266,12 +266,13 @@ class DCASGD(_StaticMixin, Optimizer):
 
     def create_state(self, index, weight):
         mom = None if self.momentum == 0.0 else jnp.zeros(weight.shape, weight.dtype)
-        prev = jnp.asarray(weight._data)
+        # distinct buffer: the fused step donates both params and states
+        prev = jnp.array(weight._data, copy=True)
         return (mom, prev)
 
     def init_state_arrays(self, weight):
         mom = None if self.momentum == 0.0 else jnp.zeros(weight.shape, weight.dtype)
-        return (mom, jnp.asarray(weight))
+        return (mom, jnp.array(weight, copy=True))
 
     def apply(self, w, g, state, lr, wd, t):
         mom, prev = state
@@ -362,12 +363,16 @@ class RMSProp(_StaticMixin, Optimizer):
         self.gamma2 = gamma2
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight.dtype)
-        return (z, z, z)  # n, g, delta
+        def z():
+            return jnp.zeros(weight.shape, weight.dtype)
+
+        return (z(), z(), z())  # n, g, delta — distinct buffers (donation)
 
     def init_state_arrays(self, weight):
-        z = jnp.zeros(weight.shape, weight.dtype)
-        return (z, z, z)
+        def z():
+            return jnp.zeros(weight.shape, weight.dtype)
+
+        return (z(), z(), z())
 
     def apply(self, w, g, state, lr, wd, t):
         n, gbar, delta = state
@@ -393,12 +398,16 @@ class AdaDelta(_StaticMixin, Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight.dtype)
-        return (z, z)
+        def z():
+            return jnp.zeros(weight.shape, weight.dtype)
+
+        return (z(), z())  # distinct buffers (donation)
 
     def init_state_arrays(self, weight):
-        z = jnp.zeros(weight.shape, weight.dtype)
-        return (z, z)
+        def z():
+            return jnp.zeros(weight.shape, weight.dtype)
+
+        return (z(), z())
 
     def apply(self, w, g, state, lr, wd, t):
         acc_g, acc_delta = state

@@ -133,3 +133,97 @@ def test_module_bucketing_shared():
     a1, _ = mod.get_params()
     a2, _ = mod2.get_params()
     np.testing.assert_allclose(a1["fc1_weight"].asnumpy(), a2["fc1_weight"].asnumpy())
+
+
+def test_module_tied_param_buffers_train():
+    """Two trainable params sharing one buffer must not break the fused
+    (donating) step — regression for 'donate the same buffer twice'."""
+    data = mx.sym.Variable("data")
+    a = mx.sym.FullyConnected(data, num_hidden=16, no_bias=True, name="enc")
+    a = mx.sym.Activation(a, act_type="tanh")
+    out = mx.sym.FullyConnected(a, num_hidden=16, no_bias=True, name="dec")
+    net = mx.sym.LinearRegressionOutput(out, name="lro")
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(64, 16).astype(np.float32)
+    it = mx.io.NDArrayIter(X, X[:, :16], batch_size=16, label_name="lro_label")
+    mod = mx.mod.Module(net, context=mx.cpu(), label_names=("lro_label",))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    # tie: both weights literally share one jax buffer
+    w = mod._exec.arg_dict["enc_weight"]
+    mod._exec.arg_dict["dec_weight"]._set_data(w._data)
+    assert mod._exec.arg_dict["dec_weight"]._data is w._data
+    mod.init_optimizer(kvstore=None, optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    for b in it:
+        mod.forward_backward(b)
+        mod.update()
+    out = mod.get_outputs()[0].asnumpy()
+    assert np.all(np.isfinite(out))
+
+
+def test_module_copy_initialized_states_train():
+    """arg_params built from an array and its .copy() (the RNN-state
+    pattern) must produce distinct donated buffers and train."""
+    z = mx.nd.zeros((4, 4))
+    z2 = z.copy()
+    assert z2._data is not z._data
+
+    data = mx.sym.Variable("data")
+    a = mx.sym.Variable("a_weight")
+    b = mx.sym.Variable("b_weight")
+    net = mx.sym.FullyConnected(data, weight=a, num_hidden=4, no_bias=True,
+                                name="fa")
+    net = mx.sym.FullyConnected(net, weight=b, num_hidden=4, no_bias=True,
+                                name="fb")
+    net = mx.sym.LinearRegressionOutput(mx.sym.sum(net, axis=1), name="lro")
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 4).astype(np.float32)
+    it = mx.io.NDArrayIter(X, X.sum(axis=1), batch_size=8, label_name="lro_label")
+    mod = mx.mod.Module(net, context=mx.cpu(), label_names=("lro_label",))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    w = mx.nd.array(rng.randn(4, 4).astype(np.float32) * 0.1)
+    mod.init_params(arg_params={"a_weight": w, "b_weight": w.copy()},
+                    allow_missing=True)
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.01})
+    for _ in range(2):
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+    out = mod.get_outputs()[0].asnumpy()
+    assert np.all(np.isfinite(out))
+
+
+def test_module_param_aliased_to_frozen_buffer_train():
+    """A trainable param sharing a buffer with a frozen (grad_req null)
+    param must not get the shared buffer deleted by donation."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, no_bias=True, name="enc")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=16, no_bias=True, name="dec")
+    net = mx.sym.LinearRegressionOutput(net, name="lro")
+    rng = np.random.RandomState(1)
+    X = rng.randn(64, 16).astype(np.float32)
+    it = mx.io.NDArrayIter(X, X, batch_size=16, label_name="lro_label")
+    mod = mx.mod.Module(net, context=mx.cpu(), label_names=("lro_label",),
+                        fixed_param_names=["dec_weight"])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    # frozen dec_weight shares the trainable enc_weight's buffer
+    w = mod._exec.arg_dict["enc_weight"]
+    mod._exec.arg_dict["dec_weight"]._set_data(w._data)
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.01})
+    for _ in range(3):  # >1 step: step 2 re-reads the frozen buffer
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+    out = mod.get_outputs()[0].asnumpy()
+    assert np.all(np.isfinite(out))
+    # the frozen param's buffer must still be alive and unchanged shape
+    assert mod._exec.arg_dict["dec_weight"].asnumpy().shape == (16, 16)
