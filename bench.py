@@ -2,11 +2,27 @@
 """Benchmark: ResNet-50 ImageNet training throughput on one TPU chip.
 
 Prints ONE JSON line:
-  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N,
+   "mfu": N, "precision": "...", "tflops": N, "step_ms": N,
+   "step_ms_sync": N, "loss_first": N, "loss_last": N}
 
 Baseline: the reference's strongest published single-chip number —
 ResNet-50 training, batch 32, 181.53 img/s on P100
 (docs/how_to/perf.md:131-138; see BASELINE.md).
+
+Honest-accounting notes (VERDICT r02 §weak-3):
+- FLOPs are counted analytically from the bound symbol's conv/FC shapes
+  (2*MAC forward; backward = 2x forward for data+weight grads, i.e.
+  train = 3x fwd — the convention behind the published MFU numbers).
+- `mfu` is achieved TFLOP/s over the chip's bf16 peak.  JAX's default
+  matmul precision on TPU is bf16 inputs with fp32 accumulation;
+  BENCH_PRECISION=float32 forces full fp32 matmuls for comparison with
+  the reference's fp32 numbers and is disclosed in the JSON.
+- `step_ms_sync` times a sample of steps each blocked to completion
+  (no async-dispatch pipelining) to cross-check the wall-clock claim;
+  `loss_first`/`loss_last` is a convergence canary (softmax CE on the
+  synthetic set must decrease) so the number can't come from a
+  degenerate program.
 
 The training step is the framework's fused path: the whole
 forward+backward+SGD-update graph lowered to a single donated XLA
@@ -27,13 +43,77 @@ import jax
 jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+PRECISION = os.environ.get("BENCH_PRECISION", "bf16")
+if PRECISION == "float32":
+    jax.config.update("jax_default_matmul_precision", "highest")
+
 import numpy as np
 
 BASELINE_IMG_S = 181.53  # P100, reference perf.md:131-138
 
+# per-chip bf16 peak TFLOP/s by device kind (public spec sheets)
+_PEAK_TFLOPS = {
+    "TPU v2": 22.5, "TPU v3": 61.5, "TPU v4": 137.5,
+    "TPU v5 lite": 197.0, "TPU v5e": 197.0, "TPU v5": 229.5,
+    "TPU v5p": 229.5, "TPU v6 lite": 459.0, "TPU v6e": 459.0,
+}
+
 
 def log(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _peak_tflops():
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return None, "unknown"
+    for k, v in _PEAK_TFLOPS.items():
+        if kind.startswith(k):
+            return v, kind
+    return None, kind
+
+
+def count_fwd_flops(sym, batch, data_shape, label_shape):
+    """Analytic forward FLOPs (2*MAC) of every conv/FC in the graph,
+    from inferred shapes.  BN/activation/pool (<2% of ResNet FLOPs) are
+    left out, so the count — and therefore the reported MFU — errs on
+    the low side."""
+    g = json.loads(sym.tojson())
+    nodes = g["nodes"]
+    row = g["node_row_ptr"]
+    internals = sym.get_internals()
+    _, out_shapes, _ = internals.infer_shape(
+        data=(batch,) + tuple(data_shape), softmax_label=(batch,) + tuple(label_shape))
+
+    def shape_of(node_id, out_idx=0):
+        return out_shapes[row[node_id] + out_idx]
+
+    flops = 0
+    for i, n in enumerate(nodes):
+        op = n.get("op")
+        if op not in ("Convolution", "FullyConnected", "Deconvolution"):
+            continue
+        attr = n.get("attr", {}) or {}
+        in_shape = shape_of(n["inputs"][0][0], n["inputs"][0][1])
+        out_shape = shape_of(i)
+        if op in ("Convolution", "Deconvolution"):
+            kh, kw = eval(attr.get("kernel", "(1, 1)"))
+            groups = int(attr.get("num_group", "1"))
+            cin = in_shape[1]
+            nfl = 2 * int(np.prod(out_shape)) * (cin // groups) * kh * kw
+        else:  # FullyConnected
+            cin = int(np.prod(in_shape[1:]))
+            nfl = 2 * out_shape[0] * cin * out_shape[1]
+            if attr.get("no_bias", "False") != "True":
+                nfl += int(np.prod(out_shape))
+        flops += nfl
+    return flops
+
+
+def _ce_loss(probs, labels):
+    p = probs[np.arange(len(labels)), labels.astype(np.int64)]
+    return float(-np.mean(np.log(np.maximum(p, 1e-12))))
 
 
 def main():
@@ -43,22 +123,33 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", "32"))
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))
     iters = int(os.environ.get("BENCH_ITERS", "200"))
+    sync_iters = int(os.environ.get("BENCH_SYNC_ITERS", "20"))
 
-    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    log(f"backend={jax.default_backend()} devices={jax.devices()} "
+        f"precision={PRECISION}")
     sym = models.resnet(num_classes=1000, num_layers=50, image_shape=(3, 224, 224))
     ctx = mx.tpu() if mx.context.num_devices() else mx.cpu()
+
+    fwd_flops = count_fwd_flops(sym, batch, (3, 224, 224), ())
+    train_flops = 3 * fwd_flops  # fwd + data-grad + weight-grad
+    log(f"analytic conv/FC FLOPs: fwd {fwd_flops/1e9:.2f} GF/batch, "
+        f"train {train_flops/1e9:.2f} GF/batch "
+        f"({train_flops/batch/1e9:.2f} GF/img)")
 
     # Synthetic device-resident batches, cycled — the reference's own
     # benchmark methodology (train_imagenet --benchmark / benchmark_score
     # generate data on-device once and loop); measures the training step,
-    # not this sandbox's tunnel bandwidth.
+    # not this sandbox's tunnel bandwidth.  Labels are fixed per batch so
+    # the model can memorize them — the convergence canary below.
     rng = np.random.RandomState(0)
     n_batches = 4
-    batches = []
+    batches, labels_np = [], []
     for i in range(n_batches):
         Xb = mx.nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32), ctx=ctx)
-        yb = mx.nd.array(rng.randint(0, 1000, size=batch).astype(np.float32), ctx=ctx)
+        y = rng.randint(0, 1000, size=batch).astype(np.float32)
+        yb = mx.nd.array(y, ctx=ctx)
         batches.append(mx.io.DataBatch([Xb], [yb]))
+        labels_np.append(y)
     provide_data = [mx.io.DataDesc("data", (batch, 3, 224, 224))]
     provide_label = [mx.io.DataDesc("softmax_label", (batch,))]
 
@@ -68,30 +159,65 @@ def main():
              for_training=True)
     mod.init_params(mx.initializer.Xavier(factor_type="in", magnitude=2.34))
     mod.init_optimizer(kvstore=None, optimizer="sgd",
-                       optimizer_params={"learning_rate": 0.01, "momentum": 0.9})
+                       optimizer_params={"learning_rate": 0.005, "momentum": 0.9})
     log(f"bind+init {time.time()-t0:.1f}s")
 
     t0 = time.time()
     for i in range(warmup):
         mod.forward_backward(batches[i % n_batches])
         mod.update()
-    mod.get_outputs()[0].wait_to_read()
-    log(f"warmup+compile {time.time()-t0:.1f}s")
+    loss_first = _ce_loss(mod.get_outputs()[0].asnumpy(),
+                          labels_np[(warmup - 1) % n_batches])
+    log(f"warmup+compile {time.time()-t0:.1f}s  loss_first={loss_first:.4f}")
 
+    # pipelined (async-dispatch) timing — the headline number
     t0 = time.time()
     for i in range(iters):
         mod.forward_backward(batches[i % n_batches])
         mod.update()
     mod.get_outputs()[0].wait_to_read()
     dt = time.time() - t0
+    loss_last = _ce_loss(mod.get_outputs()[0].asnumpy(),
+                         labels_np[(warmup + iters - 1) % n_batches])
+
+    # sync-sampled timing: each step blocked to completion — no
+    # dispatch pipelining can hide device time here
+    t_sync = time.time()
+    for i in range(sync_iters):
+        mod.forward_backward(batches[i % n_batches])
+        mod.update()
+        mod.get_outputs()[0].wait_to_read()
+    dt_sync = (time.time() - t_sync) / max(sync_iters, 1)
 
     img_s = batch * iters / dt
-    log(f"{iters} steps in {dt:.2f}s = {dt/iters*1000:.1f} ms/step")
+    step_ms = dt / iters * 1000
+    tflops = img_s * (train_flops / batch) / 1e12
+    peak, kind = _peak_tflops()
+    mfu = round(tflops / peak, 4) if peak else None
+    canary_ok = loss_last < loss_first
+    log(f"{iters} steps in {dt:.2f}s = {step_ms:.2f} ms/step (pipelined); "
+        f"sync sample {dt_sync*1000:.2f} ms/step")
+    log(f"achieved {tflops:.1f} TFLOP/s on {kind} "
+        f"(bf16 peak {peak}) -> MFU {mfu if mfu is not None else 'n/a'} "
+        f"precision={PRECISION}")
+    log(f"convergence canary: loss {loss_first:.4f} -> {loss_last:.4f} "
+        f"({'OK' if canary_ok else 'FAILED — number is not trustworthy'})")
+    if not canary_ok:
+        log("WARNING: loss did not decrease; refusing to report throughput")
+        sys.exit(1)
+
     print(json.dumps({
         "metric": "resnet50_train_throughput",
         "value": round(img_s, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "mfu": mfu,
+        "precision": PRECISION,
+        "tflops": round(tflops, 1),
+        "step_ms": round(step_ms, 3),
+        "step_ms_sync": round(dt_sync * 1000, 3),
+        "loss_first": round(loss_first, 4),
+        "loss_last": round(loss_last, 4),
     }))
 
 
