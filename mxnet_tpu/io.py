@@ -386,7 +386,10 @@ class PrefetchingIter(DataIter):
             gen, item = self._queues[i].get()
             if (isinstance(item, tuple) and len(item) == 2
                     and item[0] is PrefetchingIter._ERR):
-                raise MXNetError(f"prefetch worker died: {item[1]!r}") from item[1]
+                if gen == self._gen:
+                    raise MXNetError(
+                        f"prefetch worker died: {item[1]!r}") from item[1]
+                continue  # stale error from a generation reset() already retired
             if gen == self._gen:
                 return item
 

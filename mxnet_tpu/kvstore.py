@@ -112,11 +112,15 @@ class KVStore:
         return jax.process_count()
 
     def barrier(self):
-        """reference: kvstore.h Barrier — all-process sync point."""
-        # a tiny global psum forces a cross-process rendezvous
+        """reference: kvstore.h Barrier — all-process sync point.
+
+        Single-process stores have nothing to rendezvous with; in a
+        multi-process runtime this delegates to a real global sync so
+        `local`/`device` users get correct (not silently fake) semantics."""
         if jax.process_count() > 1:
-            x = jnp.ones(())
-            jax.block_until_ready(x)
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")
 
     def get_num_dead_node(self, node_id=0, timeout=0):
         """reference: kvstore.h:242 — JAX runtime handles liveness; a
@@ -183,10 +187,21 @@ class DistKVStore(TPUKVStore):
                 if "already" in str(exc).lower():
                     pass  # launcher/driver initialized it — fine
                 else:
+                    # the launcher asked for N processes; degrading to
+                    # single-process would train on 1/N of the data while
+                    # looking healthy (the reference's ps-lite connects or
+                    # dies, kvstore_dist.h:33-38) — so die too
+                    nproc = int(os.environ.get("JAX_NUM_PROCESSES",
+                                os.environ.get("NUM_PROCESSES", "1")))
+                    if nproc > 1:
+                        raise MXNetError(
+                            f"kvstore {kv_type!r}: jax.distributed.initialize "
+                            f"failed with {nproc} configured processes: {exc}. "
+                            "Initialize the distributed runtime before any "
+                            "jax array is created.") from exc
                     logging.warning(
                         "kvstore %r: jax.distributed.initialize failed (%s); "
-                        "training will proceed SINGLE-PROCESS. Initialize the "
-                        "distributed runtime before creating jax arrays.",
+                        "single configured process — proceeding locally.",
                         kv_type, exc)
 
     def barrier(self):

@@ -651,8 +651,13 @@ class Module(BaseModule):
             kwargs = self._pending_batch
             self._pending_batch = None
             self._exec.forward(is_train=True, **kwargs)
-            self._exec.backward()
-            self._flushed_backward = True
+            if all(r in ("write", "null")
+                   for r in self._exec.grad_req.values()):
+                self._exec.backward()
+                self._flushed_backward = True
+            # grad_req='add': leave gradients untouched — an output query
+            # must not accumulate a contribution; the user's backward()
+            # call does it exactly once
         return self._exec.outputs
 
     def get_input_grads(self, merge_multi_context=True):
