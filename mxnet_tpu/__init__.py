@@ -35,6 +35,8 @@ from . import metric
 from . import lr_scheduler
 from . import callback
 from . import io
+from . import recordio
+from . import image
 from . import kvstore
 from . import kvstore as kv
 from . import model

@@ -1,0 +1,336 @@
+"""Host-side image decode + augmentation.
+
+Capability parity with ``python/mxnet/image.py`` (455 LoC) and the C++
+default augmenter ``src/io/image_aug_default.cc`` (336 LoC; SURVEY
+§2.5): decode, resize-short, crops (fixed/center/random/random-sized),
+rotation/shear/aspect/scale jitter, HSL jitter, mirror, color
+normalize.
+
+TPU-first design note: augmentation is a host-side numpy/cv2 pipeline
+(cv2 releases the GIL, so ``ImageRecordIter``'s thread pool scales);
+everything after batch assembly — mean subtraction, scale, layout —
+is vectorized per batch so the per-sample Python work stays minimal.
+Images are HWC uint8/float32 on the host and become NCHW device
+arrays only at batch staging time.
+"""
+
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as np
+
+try:
+    import cv2
+except ImportError:  # pragma: no cover
+    cv2 = None
+
+__all__ = [
+    "imdecode", "imresize", "scale_down", "resize_short", "fixed_crop",
+    "random_crop", "center_crop", "random_size_crop", "color_normalize",
+    "HorizontalFlipAug", "ResizeAug", "ForceResizeAug", "RandomCropAug",
+    "CenterCropAug", "RandomSizedCropAug", "ColorJitterAug", "HSLJitterAug",
+    "RandomRotateShearAug", "CastAug", "RandomOrderAug", "CreateAugmenter",
+]
+
+
+def imdecode(buf, iscolor=1, to_rgb=True):
+    """Decode an encoded (JPEG/PNG/...) byte buffer to an HWC uint8 array."""
+    assert cv2 is not None, "imdecode requires cv2"
+    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), iscolor)
+    if img is None:
+        raise ValueError("cannot decode image buffer")
+    if to_rgb and img.ndim == 3 and img.shape[2] == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    return img
+
+
+def imresize(img, w, h, interp=None):
+    interp = interp if interp is not None else (cv2.INTER_LINEAR if cv2 else 1)
+    return cv2.resize(img, (w, h), interpolation=interp)
+
+
+def scale_down(src_size, size):
+    """Scale ``size`` down to fit inside ``src_size``, keeping aspect."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(img, size, interp=None):
+    """Resize so the shorter side equals ``size``."""
+    h, w = img.shape[:2]
+    if h > w:
+        new_w, new_h = size, size * h // w
+    else:
+        new_w, new_h = size * w // h, size
+    return imresize(img, new_w, new_h, interp)
+
+
+def fixed_crop(img, x0, y0, w, h, size=None, interp=None):
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(img, size, interp=None, rng=_pyrandom):
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = rng.randint(0, w - new_w)
+    y0 = rng.randint(0, h - new_h)
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(img, size, interp=None):
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(img, size, min_area=0.08, ratio=(3 / 4, 4 / 3), interp=None,
+                     rng=_pyrandom):
+    """Random area+aspect crop (inception-style)."""
+    h, w = img.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = rng.uniform(min_area, 1.0) * area
+        aspect = rng.uniform(*ratio)
+        new_w = int(round(np.sqrt(target_area * aspect)))
+        new_h = int(round(np.sqrt(target_area / aspect)))
+        if rng.random() < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = rng.randint(0, w - new_w)
+            y0 = rng.randint(0, h - new_h)
+            out = fixed_crop(img, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(img, size, interp)
+
+
+def color_normalize(img, mean, std=None):
+    img = img.astype(np.float32) - mean
+    if std is not None:
+        img = img / std
+    return img
+
+
+# ---------------------------------------------------------------------------
+# Augmenters: callables HWC-array -> HWC-array, composable in a list.
+# ---------------------------------------------------------------------------
+
+class Augmenter:
+    """Callable HWC->HWC transform.  ``rng`` is a ``random.Random``-like
+    source; ImageRecordIter passes a per-(seed, epoch, record) instance
+    so augmentation is reproducible under any thread schedule."""
+
+    def __call__(self, img, rng=_pyrandom):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    """Resize shorter edge to ``size``."""
+
+    def __init__(self, size, interp=None):
+        self.size, self.interp = size, interp
+
+    def __call__(self, img, rng=_pyrandom):
+        return resize_short(img, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    """Resize to exactly (w, h), ignoring aspect ratio."""
+
+    def __init__(self, size, interp=None):
+        self.size, self.interp = size, interp
+
+    def __call__(self, img, rng=_pyrandom):
+        return imresize(img, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=None):
+        self.size, self.interp = size, interp
+
+    def __call__(self, img, rng=_pyrandom):
+        return random_crop(img, self.size, self.interp, rng)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=None):
+        self.size, self.interp = size, interp
+
+    def __call__(self, img, rng=_pyrandom):
+        return center_crop(img, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, min_area=0.08, ratio=(3 / 4, 4 / 3), interp=None):
+        self.size, self.min_area, self.ratio, self.interp = size, min_area, ratio, interp
+
+    def __call__(self, img, rng=_pyrandom):
+        return random_size_crop(img, self.size, self.min_area, self.ratio,
+                                self.interp, rng)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, img, rng=_pyrandom):
+        if rng.random() < self.p:
+            return np.ascontiguousarray(img[:, ::-1])
+        return img
+
+
+class CastAug(Augmenter):
+    def __call__(self, img, rng=_pyrandom):
+        return img.astype(np.float32)
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in random order."""
+
+    def __init__(self, ts):
+        self.ts = list(ts)
+
+    def __call__(self, img, rng=_pyrandom):
+        ts = list(self.ts)
+        rng.shuffle(ts)
+        for t in ts:
+            img = t(img, rng)
+        return img
+
+
+class HSLJitterAug(Augmenter):
+    """Random hue/saturation/lightness jitter (image_aug_default.cc
+    random_h/random_s/random_l behavior, done in HLS space)."""
+
+    def __init__(self, random_h=0, random_s=0, random_l=0):
+        self.random_h, self.random_s, self.random_l = random_h, random_s, random_l
+
+    def __call__(self, img, rng=_pyrandom):
+        if not (self.random_h or self.random_s or self.random_l):
+            return img
+        hls = cv2.cvtColor(img.astype(np.uint8), cv2.COLOR_RGB2HLS).astype(np.int16)
+        dh = rng.uniform(-self.random_h, self.random_h)
+        dl = rng.uniform(-self.random_l, self.random_l)
+        ds = rng.uniform(-self.random_s, self.random_s)
+        hls[..., 0] = (hls[..., 0] + int(dh / 2)) % 180
+        hls[..., 1] = np.clip(hls[..., 1] + int(dl), 0, 255)
+        hls[..., 2] = np.clip(hls[..., 2] + int(ds), 0, 255)
+        return cv2.cvtColor(hls.astype(np.uint8), cv2.COLOR_HLS2RGB)
+
+
+class ColorJitterAug(Augmenter):
+    """Brightness/contrast/saturation jitter on float images."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0):
+        self.brightness, self.contrast, self.saturation = brightness, contrast, saturation
+        self._coef = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __call__(self, img, rng=_pyrandom):
+        img = img.astype(np.float32)
+        if self.brightness > 0:
+            img = img * (1.0 + rng.uniform(-self.brightness, self.brightness))
+        if self.contrast > 0:
+            alpha = 1.0 + rng.uniform(-self.contrast, self.contrast)
+            gray = (img * self._coef).sum(axis=2, keepdims=True)
+            img = img * alpha + gray.mean() * (1 - alpha)
+        if self.saturation > 0:
+            alpha = 1.0 + rng.uniform(-self.saturation, self.saturation)
+            gray = (img * self._coef).sum(axis=2, keepdims=True)
+            img = img * alpha + gray * (1 - alpha)
+        return img
+
+
+class RandomRotateShearAug(Augmenter):
+    """Rotation/shear/scale warp (image_aug_default.cc:96-200 behavior)."""
+
+    def __init__(self, max_rotate_angle=0, max_shear_ratio=0,
+                 min_random_scale=1.0, max_random_scale=1.0,
+                 max_aspect_ratio=0, fill_value=255, interp=None):
+        self.max_rotate_angle = max_rotate_angle
+        self.max_shear_ratio = max_shear_ratio
+        self.min_random_scale = min_random_scale
+        self.max_random_scale = max_random_scale
+        self.max_aspect_ratio = max_aspect_ratio
+        self.fill_value = fill_value
+        self.interp = interp
+
+    def __call__(self, img, rng=_pyrandom):
+        h, w = img.shape[:2]
+        angle = rng.uniform(-self.max_rotate_angle, self.max_rotate_angle)
+        shear = rng.uniform(-self.max_shear_ratio, self.max_shear_ratio)
+        scale = rng.uniform(self.min_random_scale, self.max_random_scale)
+        ratio = 1.0 + rng.uniform(-self.max_aspect_ratio, self.max_aspect_ratio)
+        if angle == 0 and shear == 0 and scale == 1.0 and ratio == 1.0:
+            return img
+        a = np.deg2rad(angle)
+        hs, ws = scale * np.sqrt(1.0 / max(ratio, 1e-8)), scale * np.sqrt(ratio)
+        M = np.array([
+            [ws * np.cos(a) + shear * np.sin(a),
+             shear * np.cos(a) - ws * np.sin(a), 0],
+            [hs * np.sin(a), hs * np.cos(a), 0]], np.float32)
+        c = np.array([w / 2, h / 2], np.float32)
+        M[:, 2] = c - M[:, :2] @ c
+        interp = self.interp if self.interp is not None else cv2.INTER_LINEAR
+        return cv2.warpAffine(
+            img, M, (w, h), flags=interp,
+            borderMode=cv2.BORDER_CONSTANT,
+            borderValue=(self.fill_value,) * 3)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, random_h=0, random_s=0,
+                    random_l=0, max_rotate_angle=0, max_shear_ratio=0,
+                    max_aspect_ratio=0, min_random_scale=1.0,
+                    max_random_scale=1.0, fill_value=255, inter_method=None):
+    """Build the default augmenter list (ref: image.py CreateAugmenter +
+    image_aug_default.cc param behavior).  ``data_shape`` is CHW."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if (max_rotate_angle or max_shear_ratio or max_aspect_ratio
+            or min_random_scale != 1.0 or max_random_scale != 1.0):
+        auglist.append(RandomRotateShearAug(
+            max_rotate_angle, max_shear_ratio, min_random_scale,
+            max_random_scale, max_aspect_ratio, fill_value, inter_method))
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, interp=inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    if random_h or random_s or random_l:
+        auglist.append(HSLJitterAug(random_h, random_s, random_l))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        std_ = np.asarray(std, np.float32) if std is not None else None
+
+        class _Norm(Augmenter):
+            def __call__(self, img, rng=_pyrandom):
+                return color_normalize(img, mean, std_)
+
+        auglist.append(_Norm())
+    return auglist

@@ -5,7 +5,8 @@ Parity with ``python/mxnet/io.py`` (684 LoC) + the C++ iterators of
 pad, last_batch_handle), ResizeIter, PrefetchingIter (background
 thread double-buffering — the reference's ``PrefetcherIter``),
 MNISTIter (idx-format files, ``src/io/iter_mnist.cc``), CSVIter
-(``src/io/iter_csv.cc``).  ImageRecordIter lives in ``io/record.py``.
+(``src/io/iter_csv.cc``).  ImageRecordIter lives in ``io_record.py``
+and is re-exported here.
 
 TPU note: host-side numpy pipeline feeding committed device arrays;
 PrefetchingIter overlaps host decode with device compute (the
@@ -485,3 +486,10 @@ class CSVIter(NDArrayIter):
         super().__init__(data, label, batch_size=batch_size,
                          last_batch_handle="pad" if round_batch else "discard",
                          label_name="label", **kwargs)
+
+
+# re-export: the packed-image pipeline lives in io_record.py (it needs
+# the base classes defined above, hence the tail import)
+from .io_record import ImageRecordIter  # noqa: E402
+
+__all__.append("ImageRecordIter")

@@ -1,0 +1,250 @@
+"""ImageRecordIter: the packed-image training data pipeline.
+
+Capability parity with the reference's C++ chain
+``ImageRecordIOParser → ImageAugmenter → ImageNormalizeIter →
+BatchLoader → PrefetcherIter`` (``src/io/iter_image_recordio.cc:29-120``,
+``image_aug_default.cc``, ``iter_normalize.h``, ``iter_batchloader.h``;
+SURVEY §2.5), including ``num_parts``/``part_index`` sharding for
+distributed workers and mean-image caching.
+
+TPU-first design: record framing is native C++ (``native/recordio.cc``),
+JPEG decode + augmentation run in a thread pool (cv2 releases the GIL),
+normalization is vectorized per batch, and device staging/overlap comes
+from wrapping in ``PrefetchingIter(ctx=...)`` rather than a bespoke
+prefetch thread — one prefetch mechanism for every iterator.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random as _pyrandom
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from . import image as _image
+from . import ndarray as nd
+from . import recordio as rio
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["ImageRecordIter"]
+
+
+class ImageRecordIter(DataIter):
+    """Iterate packed-image records as augmented NCHW float batches.
+
+    Parameters mirror the reference iterator's
+    (``iter_image_recordio.cc:93-120`` + augmenter/normalize params):
+    ``path_imgrec``, ``path_imgidx``, ``data_shape`` (CHW), ``batch_size``,
+    ``label_width``, ``shuffle``, ``num_parts``/``part_index`` (worker
+    sharding), ``round_batch`` (wrap the last partial batch and report
+    ``pad``), ``preprocess_threads``, mean/std/scale normalization
+    (``mean_img`` file caching like iter_normalize.h), and the
+    augmentation knobs (resize, rand_crop, rand_mirror, rotate/shear/
+    scale/aspect, HSL).
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, label_width=1, shuffle=False, seed=0,
+                 mean_img=None, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=0.0, std_g=0.0, std_b=0.0, scale=1.0,
+                 resize=0, rand_crop=False, rand_resize=False,
+                 rand_mirror=False, max_rotate_angle=0, max_shear_ratio=0,
+                 max_aspect_ratio=0, min_random_scale=1.0,
+                 max_random_scale=1.0, random_h=0, random_s=0, random_l=0,
+                 fill_value=255, inter_method=None,
+                 num_parts=1, part_index=0, round_batch=True,
+                 preprocess_threads=4, data_name="data",
+                 label_name="softmax_label", dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        if kwargs:
+            # the reference C++ iterator rejects unknown parameters too
+            raise TypeError("unsupported ImageRecordIter parameters: "
+                            f"{sorted(kwargs)}")
+        if not os.path.isfile(path_imgrec):
+            raise MXNetError(f"ImageRecordIter: no such file {path_imgrec!r}")
+        assert len(data_shape) == 3, "data_shape must be (C, H, W)"
+        assert 0 <= part_index < num_parts
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.round_batch = round_batch
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = np.dtype(dtype)
+        self._seed = seed
+        self._epoch = 0
+        self._rng = np.random.RandomState(seed)
+        self._path_imgrec = path_imgrec
+        # one reader per decode thread: seek+read is stateful
+        self._tls = threading.local()
+
+        # --- record offsets, sharded across workers -------------------
+        if path_imgidx and os.path.isfile(path_imgidx):
+            keys, idx = rio.read_idx_file(path_imgidx)
+            offsets = [idx[k] for k in keys]
+        else:
+            offsets = rio.list_records(path_imgrec)
+        if not offsets:
+            raise MXNetError(f"ImageRecordIter: {path_imgrec!r} is empty")
+        # strided partition: same per-worker count (±1) without needing
+        # the byte-balanced InputSplit machinery of dmlc-core
+        self._offsets = np.asarray(offsets[part_index::num_parts], np.int64)
+        self.num_data = len(self._offsets)
+        if self.num_data < batch_size and not round_batch:
+            raise MXNetError("fewer records than batch_size in this part")
+
+        # --- augmentation pipeline ------------------------------------
+        self._auglist = _image.CreateAugmenter(
+            self.data_shape, resize=resize, rand_crop=rand_crop,
+            rand_resize=rand_resize, rand_mirror=rand_mirror,
+            random_h=random_h, random_s=random_s, random_l=random_l,
+            max_rotate_angle=max_rotate_angle,
+            max_shear_ratio=max_shear_ratio,
+            max_aspect_ratio=max_aspect_ratio,
+            min_random_scale=min_random_scale,
+            max_random_scale=max_random_scale,
+            fill_value=fill_value, inter_method=inter_method)
+
+        # --- normalization (iter_normalize.h behavior) ----------------
+        c = self.data_shape[0]
+        self._scale = float(scale)
+        self._mean = None   # (C,1,1) or full CHW image
+        self._std = None
+        if any((mean_r, mean_g, mean_b)):
+            self._mean = np.array([mean_r, mean_g, mean_b][:c],
+                                  np.float32).reshape(c, 1, 1)
+        if any((std_r, std_g, std_b)):
+            self._std = np.array([std_r or 1, std_g or 1, std_b or 1][:c],
+                                 np.float32).reshape(c, 1, 1)
+        if mean_img:
+            self._mean = self._load_or_compute_mean(mean_img)
+
+        self._pool = ThreadPoolExecutor(max_workers=max(1, preprocess_threads))
+        self._order = np.arange(self.num_data)
+        self._cursor = 0
+        self._seen_epoch_end = False
+        self.reset()
+
+    # ------------------------------------------------------------------
+    def _read_at(self, offset):
+        rec = getattr(self._tls, "record", None)
+        if rec is None:
+            rec = rio.MXRecordIO(self._path_imgrec, "r")
+            self._tls.record = rec
+        rec.seek(int(offset))
+        s = rec.read()
+        if s is None:
+            raise MXNetError("truncated record file")
+        return s
+
+    def _decode_one(self, offset):
+        c = self.data_shape[0]
+        header, img = rio.unpack_img(self._read_at(offset),
+                                     iscolor=0 if c == 1 else 1)
+        if c == 1:
+            img = img[:, :, None]  # HW -> HW1
+        else:
+            if img.ndim == 2:
+                img = img[:, :, None].repeat(3, axis=2)
+            img = img[:, :, ::-1]  # BGR -> RGB (augmenters/means are RGB)
+        # per-sample rng: reproducible regardless of thread scheduling
+        rng = _pyrandom.Random(hash((self._seed, self._epoch, int(offset))))
+        for aug in self._auglist:
+            img = aug(img, rng)
+        label = header.label
+        if isinstance(label, np.ndarray):
+            label = label[:self.label_width]
+        else:
+            label = np.array([label], np.float32)[:self.label_width]
+        chw = np.ascontiguousarray(
+            np.asarray(img, np.float32).transpose(2, 0, 1))
+        return chw, np.asarray(label, np.float32)
+
+    def _load_or_compute_mean(self, mean_path):
+        if os.path.isfile(mean_path):
+            loaded = nd.load(mean_path)
+            arr = (loaded["mean_img"] if isinstance(loaded, dict)
+                   else loaded[0])
+            return arr.asnumpy().astype(np.float32)
+        logging.info("ImageRecordIter: computing mean image -> %s", mean_path)
+        acc = np.zeros(self.data_shape, np.float64)
+        n = 0
+        for off in self._offsets:
+            chw, _ = self._decode_one(off)
+            acc += chw
+            n += 1
+        mean = (acc / max(n, 1)).astype(np.float32)
+        nd.save(mean_path, {"mean_img": nd.array(mean)})
+        return mean
+
+    # ------------------------------------------------------------------
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape,
+                         self.dtype)]
+
+    @property
+    def provide_label(self):
+        shape = ((self.batch_size,) if self.label_width == 1
+                 else (self.batch_size, self.label_width))
+        return [DataDesc(self.label_name, shape, np.float32)]
+
+    def reset(self):
+        if self.shuffle:
+            self._rng.shuffle(self._order)
+        self._epoch += 1
+        self._cursor = 0
+        self._seen_epoch_end = False
+
+    def iter_next(self):
+        return self._cursor < self.num_data and not self._seen_epoch_end
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        start = self._cursor
+        stop = start + self.batch_size
+        pad = 0
+        idxs = self._order[start:stop]
+        if stop >= self.num_data:
+            self._seen_epoch_end = True
+            if stop > self.num_data:
+                if not self.round_batch:
+                    raise StopIteration
+                pad = stop - self.num_data
+                # modular wrap: correct even when pad > num_data
+                idxs = np.concatenate(
+                    [idxs, self._order[np.arange(pad) % self.num_data]])
+        self._cursor = stop
+
+        decoded = list(self._pool.map(self._decode_one,
+                                      self._offsets[idxs]))
+        data = np.stack([d for d, _ in decoded])
+        label = np.stack([l for _, l in decoded])
+        if self.label_width == 1:
+            label = label[:, 0]
+        # vectorized normalize (iter_normalize.h: (img - mean) * scale / std)
+        if self._mean is not None:
+            data = data - self._mean
+        if self._std is not None:
+            data = data / self._std
+        if self._scale != 1.0:
+            data = data * self._scale
+        return DataBatch([nd.array(data.astype(self.dtype, copy=False))],
+                         [nd.array(label)], pad=pad,
+                         index=np.asarray(idxs),
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def close(self):
+        self._pool.shutdown(wait=False)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

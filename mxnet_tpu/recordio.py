@@ -1,0 +1,301 @@
+"""RecordIO: sequential + indexed record files and the packed-image
+record format.
+
+Capability parity with ``python/mxnet/recordio.py`` (273 LoC) and the
+dmlc recordio framing it wraps (SURVEY §2.5, §2.9):
+
+- ``MXRecordIO(uri, flag)`` — sequential read/write of length-framed
+  byte records (magic 0xced7230a + 29-bit length + 4-byte padding).
+- ``MXIndexedRecordIO(idx_path, uri, flag)`` — random access via a
+  text index file of ``key\\tbyte_offset`` lines.
+- ``IRHeader`` / ``pack`` / ``unpack`` — the image-record header
+  ``(flag:u32, label:f32, id:u64, id2:u64)``; ``flag > 0`` means
+  ``flag`` float32 labels follow the header
+  (``src/io/image_recordio.h:16-78``).
+- ``pack_img`` / ``unpack_img`` — JPEG/PNG encode/decode via cv2.
+
+The byte-level framing runs in native C++ (``native/recordio.cc``)
+when built, with an identical pure-Python fallback; both produce the
+same bytes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from . import _native
+from .base import MXNetError
+
+try:
+    import cv2
+except ImportError:  # pragma: no cover - cv2 is in the base image
+    cv2 = None
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_LEN_MASK = (1 << 29) - 1
+
+
+class MXRecordIO:
+    """Sequential record file reader/writer.
+
+    Parameters
+    ----------
+    uri : str
+        Path to the record file.
+    flag : str
+        'r' for reading, 'w' for writing.
+    """
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.is_open = False
+        self._native = None   # ctypes handle when the C++ library is used
+        self._fp = None       # python-fallback file object
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.writable = True
+        elif self.flag == "r":
+            self.writable = False
+        else:
+            raise ValueError(f"Invalid flag {self.flag}")
+        lib = _native.lib()
+        if lib is not None:
+            create = (lib.MXTPURecordIOWriterCreate if self.writable
+                      else lib.MXTPURecordIOReaderCreate)
+            h = create(self.uri.encode())
+            if not h:
+                raise MXNetError(f"cannot open {self.uri!r}")
+            self._native = h
+        else:
+            self._fp = open(self.uri, "wb" if self.writable else "rb")
+        self.is_open = True
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self._native is not None:
+            lib = _native.lib()
+            free = (lib.MXTPURecordIOWriterFree if self.writable
+                    else lib.MXTPURecordIOReaderFree)
+            free(self._native)
+            self._native = None
+        if self._fp is not None:
+            self._fp.close()
+            self._fp = None
+        self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def reset(self):
+        """Reposition to the first record ('w' truncates the file)."""
+        self.close()
+        self.open()
+
+    def tell(self):
+        if self._native is not None:
+            lib = _native.lib()
+            fn = (lib.MXTPURecordIOWriterTell if self.writable
+                  else lib.MXTPURecordIOReaderTell)
+            return fn(self._native)
+        return self._fp.tell()
+
+    def seek(self, offset):
+        assert not self.writable
+        if self._native is not None:
+            if _native.lib().MXTPURecordIOReaderSeek(self._native, offset) != 0:
+                raise MXNetError(f"seek({offset}) failed on {self.uri!r}")
+        else:
+            self._fp.seek(offset)
+
+    def write(self, buf):
+        """Append one record (bytes)."""
+        assert self.writable
+        if len(buf) > _LEN_MASK:
+            raise MXNetError("record too large (max 2^29-1 bytes)")
+        if self._native is not None:
+            rc = _native.lib().MXTPURecordIOWriterWrite(
+                self._native, buf, len(buf))
+            if rc != 0:
+                raise MXNetError(f"write failed on {self.uri!r}")
+            return
+        self._fp.write(struct.pack("<II", _MAGIC, len(buf)))
+        self._fp.write(buf)
+        pad = (-len(buf)) % 4
+        if pad:
+            self._fp.write(b"\x00" * pad)
+
+    def read(self):
+        """Read the next record; None at end of file."""
+        assert not self.writable
+        if self._native is not None:
+            size = ctypes.c_uint64()
+            ptr = _native.lib().MXTPURecordIOReaderRead(
+                self._native, ctypes.byref(size))
+            if not ptr:
+                if size.value == ctypes.c_uint64(-1).value:
+                    raise MXNetError(f"corrupt record file {self.uri!r}")
+                return None
+            return ctypes.string_at(ptr, size.value)
+        head = self._fp.read(8)
+        if not head:
+            return None
+        if len(head) != 8:
+            raise MXNetError(f"corrupt record file {self.uri!r}")
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC or (lrec >> 29) != 0:
+            raise MXNetError(f"corrupt record file {self.uri!r}")
+        length = lrec & _LEN_MASK
+        padded = (length + 3) & ~3
+        body = self._fp.read(padded)
+        if len(body) != padded:
+            raise MXNetError(f"corrupt record file {self.uri!r}")
+        return body[:length]
+
+
+def read_idx_file(idx_path, key_type=int):
+    """Parse a ``key\\toffset`` index file → (keys list, {key: offset})."""
+    keys, idx = [], {}
+    with open(idx_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) != 2:
+                continue
+            key = key_type(parts[0])
+            idx[key] = int(parts[1])
+            keys.append(key)
+    return keys, idx
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Record file with a ``key\\toffset`` text index for random access."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            self.keys, self.idx = read_idx_file(idx_path, key_type)
+
+    def close(self):
+        if self.is_open and self.writable:
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def seek_idx(self, idx):
+        """Position the reader at record ``idx``."""
+        self.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        """Random-access read of record ``idx``."""
+        self.seek_idx(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        """Append a record under key ``idx``."""
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# Image-record header; layout matches src/io/image_recordio.h:16-40.
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack ``IRHeader`` + byte payload into one record string.
+
+    header.label may be a scalar or a float vector; a vector is stored
+    after the header with ``flag`` set to its length
+    (``image_recordio.h:61-78`` Load()).
+    """
+    label = header.label
+    if not isinstance(label, (int, float, np.floating, np.integer)):
+        label = np.asarray(label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0.0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, *header) + s
+
+
+def unpack(s):
+    """Inverse of :func:`pack` → (IRHeader, payload bytes)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:4 * header.flag], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[4 * header.flag:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an HWC uint8 image and pack it with the header."""
+    assert cv2 is not None, "pack_img requires cv2"
+    if img_fmt in (".jpg", ".jpeg"):
+        encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt == ".png":
+        encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    else:
+        encode_params = None
+    ret, buf = cv2.imencode(img_fmt, img, encode_params)
+    assert ret, "failed to encode image"
+    return pack(header, buf.tobytes())
+
+
+def unpack_img(s, iscolor=-1):
+    """Inverse of :func:`pack_img` → (IRHeader, HWC uint8 image)."""
+    assert cv2 is not None, "unpack_img requires cv2"
+    header, s = unpack(s)
+    img = cv2.imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
+    return header, img
+
+
+def list_records(uri):
+    """Byte offsets of every record in ``uri`` (native fast path)."""
+    lib = _native.lib()
+    if lib is not None:
+        n = lib.MXTPURecordIOScan(uri.encode(), None, 0)
+        if n < 0:
+            raise MXNetError(f"corrupt record file {uri!r}")
+        buf = (ctypes.c_int64 * max(n, 1))()
+        lib.MXTPURecordIOScan(uri.encode(), buf, n)
+        return list(buf[:n])
+    offsets = []
+    rec = MXRecordIO(uri, "r")
+    try:
+        while True:
+            pos = rec.tell()
+            if rec.read() is None:
+                break
+            offsets.append(pos)
+    finally:
+        rec.close()
+    return offsets

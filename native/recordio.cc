@@ -1,0 +1,154 @@
+// Native RecordIO framing: the byte-level record reader/writer behind
+// mxnet_tpu.recordio (Python falls back to a struct-based
+// implementation when this library is not built).
+//
+// Role parity: dmlc-core recordio (used by the reference via
+// src/io/iter_image_recordio.cc and python/mxnet/recordio.py).  The
+// on-disk framing keeps the reference's header layout — little-endian
+// u32 magic 0xced7230a, then u32 lrec whose upper 3 bits are a
+// continuation flag and lower 29 bits the payload length, then the
+// payload padded to a 4-byte boundary — but this is a clean-room
+// implementation: records are always written whole (cflag=0), and the
+// reader rejects multipart flags instead of re-assembling them.
+//
+// C ABI only (consumed from Python via ctypes).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Writer {
+  FILE* fp;
+};
+
+struct Reader {
+  FILE* fp;
+  std::vector<char> buf;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* MXTPURecordIOWriterCreate(const char* path) {
+  FILE* fp = std::fopen(path, "wb");
+  if (!fp) return nullptr;
+  return new Writer{fp};
+}
+
+// Returns 0 on success, -1 on error (payload too large / io failure).
+int MXTPURecordIOWriterWrite(void* h, const char* data, uint64_t size) {
+  auto* w = static_cast<Writer*>(h);
+  if (size > kLenMask) return -1;
+  uint32_t header[2] = {kMagic, static_cast<uint32_t>(size)};
+  if (std::fwrite(header, sizeof(header), 1, w->fp) != 1) return -1;
+  if (size && std::fwrite(data, 1, size, w->fp) != size) return -1;
+  static const char pad[4] = {0, 0, 0, 0};
+  uint64_t rem = size & 3u;
+  if (rem && std::fwrite(pad, 1, 4 - rem, w->fp) != 4 - rem) return -1;
+  return 0;
+}
+
+int64_t MXTPURecordIOWriterTell(void* h) {
+  return std::ftell(static_cast<Writer*>(h)->fp);
+}
+
+void MXTPURecordIOWriterFree(void* h) {
+  auto* w = static_cast<Writer*>(h);
+  if (w) {
+    std::fclose(w->fp);
+    delete w;
+  }
+}
+
+void* MXTPURecordIOReaderCreate(const char* path) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return nullptr;
+  return new Reader{fp, {}};
+}
+
+// Reads the next record.  Returns a pointer (valid until the next call
+// on this handle) and fills *size; returns nullptr with *size=0 at
+// EOF and nullptr with *size=(uint64_t)-1 on a framing error.
+const char* MXTPURecordIOReaderRead(void* h, uint64_t* size) {
+  auto* r = static_cast<Reader*>(h);
+  uint32_t header[2];
+  size_t got = std::fread(header, sizeof(uint32_t), 2, r->fp);
+  if (got == 0) {
+    *size = 0;
+    return nullptr;  // clean EOF
+  }
+  if (got != 2 || header[0] != kMagic || (header[1] >> 29) != 0) {
+    *size = static_cast<uint64_t>(-1);
+    return nullptr;
+  }
+  uint32_t len = header[1] & kLenMask;
+  uint32_t padded = (len + 3u) & ~3u;
+  if (len == 0) {
+    // zero-length record: must return non-null (null + *size=0 means EOF)
+    static const char kEmpty = '\0';
+    *size = 0;
+    return &kEmpty;
+  }
+  r->buf.resize(padded);
+  if (padded && std::fread(r->buf.data(), 1, padded, r->fp) != padded) {
+    *size = static_cast<uint64_t>(-1);
+    return nullptr;
+  }
+  *size = len;
+  return r->buf.data();
+}
+
+int MXTPURecordIOReaderSeek(void* h, int64_t offset) {
+  return std::fseek(static_cast<Reader*>(h)->fp, offset, SEEK_SET);
+}
+
+int64_t MXTPURecordIOReaderTell(void* h) {
+  return std::ftell(static_cast<Reader*>(h)->fp);
+}
+
+void MXTPURecordIOReaderFree(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  if (r) {
+    std::fclose(r->fp);
+    delete r;
+  }
+}
+
+// Scans a record file and writes start-of-record byte offsets into
+// `offsets` (up to `cap` entries).  Returns the total number of
+// records, or -1 on a framing error.  Call with cap=0 to count.
+int64_t MXTPURecordIOScan(const char* path, int64_t* offsets, int64_t cap) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return -1;
+  int64_t n = 0;
+  for (;;) {
+    int64_t pos = std::ftell(fp);
+    uint32_t header[2];
+    size_t got = std::fread(header, sizeof(uint32_t), 2, fp);
+    if (got == 0) break;
+    if (got != 2 || header[0] != kMagic || (header[1] >> 29) != 0) {
+      std::fclose(fp);
+      return -1;
+    }
+    uint32_t padded = ((header[1] & kLenMask) + 3u) & ~3u;
+    if (std::fseek(fp, padded, SEEK_CUR) != 0) {
+      std::fclose(fp);
+      return -1;
+    }
+    if (n < cap) offsets[n] = pos;
+    ++n;
+  }
+  std::fclose(fp);
+  return n;
+}
+
+}  // extern "C"

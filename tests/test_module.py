@@ -39,6 +39,7 @@ def test_module_states_and_shapes():
 
 
 def test_module_fit_convergence():
+    np.random.seed(42)  # NDArrayIter shuffle draws from the global RNG
     X, y = _make_data()
     train = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True)
     mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())

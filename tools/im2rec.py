@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""im2rec: pack an image directory / list file into RecordIO.
+
+Capability parity with ``tools/im2rec.py`` of the reference (list
+generation + multi-threaded packing into .rec/.idx).  Usage:
+
+    # 1. generate a list file (label = subdirectory index)
+    python tools/im2rec.py --make-list mydata.lst /path/to/images
+
+    # 2. pack the listed images into mydata.rec + mydata.idx
+    python tools/im2rec.py mydata.lst /path/to/images
+
+List file format (one line per image): ``index\\tlabel...\\tpath``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mxnet_tpu import recordio as rio
+
+try:
+    import cv2
+except ImportError:
+    cv2 = None
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root, recursive=True, train_ratio=1.0, shuffle=True,
+              chunks=1):
+    """Scan ``root`` for images, assign integer labels per subdirectory,
+    and write ``prefix`` list file(s)."""
+    entries = []
+    if recursive:
+        label_map = {}
+        for dirpath, dirnames, filenames in sorted(os.walk(root)):
+            dirnames.sort()
+            rel = os.path.relpath(dirpath, root)
+            imgs = sorted(f for f in filenames if f.lower().endswith(_EXTS))
+            if not imgs:
+                continue
+            if rel not in label_map:
+                label_map[rel] = len(label_map)
+            for f in imgs:
+                entries.append((os.path.join(rel, f), label_map[rel]))
+        print(f"found {len(entries)} images in {len(label_map)} classes")
+    else:
+        for f in sorted(os.listdir(root)):
+            if f.lower().endswith(_EXTS):
+                entries.append((f, 0))
+    if shuffle:
+        random.shuffle(entries)
+    name = prefix if prefix.endswith(".lst") else prefix + ".lst"
+    n_train = int(len(entries) * train_ratio)
+    splits = [(name, entries[:n_train])]
+    if train_ratio < 1.0:
+        splits.append((name.replace(".lst", "_val.lst"), entries[n_train:]))
+    for fname, rows in splits:
+        with open(fname, "w") as f:
+            for i, (path, label) in enumerate(rows):
+                f.write(f"{i}\t{label}\t{path}\n")
+        print(f"wrote {fname} ({len(rows)} entries)")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def _encode_one(root, item, resize, quality, encoding, color):
+    idx, labels, path = item
+    assert cv2 is not None, "im2rec packing requires cv2"
+    img = cv2.imread(os.path.join(root, path), color)
+    if img is None:
+        return idx, None
+    if resize:
+        h, w = img.shape[:2]
+        if h > w:
+            img = cv2.resize(img, (resize, resize * h // w))
+        else:
+            img = cv2.resize(img, (resize * w // h, resize))
+    label = labels[0] if len(labels) == 1 else np.array(labels, np.float32)
+    header = rio.IRHeader(0, label, idx, 0)
+    return idx, rio.pack_img(header, img, quality=quality, img_fmt=encoding)
+
+
+def pack(lst_path, root, prefix=None, resize=0, quality=95, encoding=".jpg",
+         color=1, num_thread=4):
+    """Pack every image in ``lst_path`` into ``prefix``.rec/.idx."""
+    prefix = prefix or lst_path.rsplit(".lst", 1)[0]
+    items = list(read_list(lst_path))
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    skipped = 0
+    window = max(4 * num_thread, 8)  # bounded in-flight encodes: O(threads) RAM
+    from collections import deque
+    with ThreadPoolExecutor(max_workers=num_thread) as pool:
+        pending = deque()
+        n = 0
+
+        def drain_one():
+            nonlocal n, skipped
+            idx, payload = pending.popleft().result()
+            n += 1
+            if payload is None:
+                skipped += 1
+                return
+            rec.write_idx(idx, payload)
+            if n % 1000 == 0:
+                print(f"packed {n}/{len(items)}")
+
+        for it in items:
+            pending.append(pool.submit(_encode_one, root, it, resize,
+                                       quality, encoding, color))
+            if len(pending) >= window:
+                drain_one()
+        while pending:
+            drain_one()
+    rec.close()
+    print(f"wrote {prefix}.rec ({len(items) - skipped} records, "
+          f"{skipped} unreadable skipped)")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="list-file prefix (or path to .lst when packing)")
+    p.add_argument("root", help="image root directory")
+    p.add_argument("--make-list", action="store_true",
+                   help="generate the .lst file instead of packing")
+    p.add_argument("--no-recursive", action="store_true")
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--no-shuffle", action="store_true")
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge before packing")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    p.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    p.add_argument("--num-thread", type=int, default=4)
+    args = p.parse_args()
+    if args.make_list:
+        make_list(args.prefix, args.root, recursive=not args.no_recursive,
+                  train_ratio=args.train_ratio, shuffle=not args.no_shuffle)
+    else:
+        lst = args.prefix if args.prefix.endswith(".lst") else args.prefix + ".lst"
+        pack(lst, args.root, resize=args.resize, quality=args.quality,
+             encoding=args.encoding, color=args.color,
+             num_thread=args.num_thread)
+
+
+if __name__ == "__main__":
+    main()
