@@ -378,12 +378,32 @@ def simple_bind(symbol, ctx, grad_req="write", type_dict=None, group2ctx=None,
         req = dict(zip(arg_names, grad_req))
     else:
         req = {n: grad_req.get(n, "null") for n in arg_names}
+
+    # shared_exec: reuse the donor executor's arrays where name+shape+dtype
+    # match — the same NDArray *objects*, so params/grads stay one storage
+    # across bucketed executors (the reference's shared memory pool,
+    # graph_executor.cc:330-334/423-515; inputs differ in shape and get
+    # fresh buffers)
+    def _reusable(pool, name, shape, dt):
+        old = pool.get(name) if pool else None
+        if old is not None and tuple(old.shape) == tuple(shape) \
+                and old.dtype == np.dtype(dt):
+            return old
+        return None
+
+    sh_args = shared_exec.arg_dict if shared_exec is not None else None
+    sh_grads = shared_exec.grad_dict if shared_exec is not None else None
+    sh_aux = shared_exec.aux_dict if shared_exec is not None else None
     for name, shape, dt in zip(arg_names, arg_shapes, arg_types):
-        args[name] = nd_zeros(shape, ctx, dt)
+        shared = _reusable(sh_args, name, shape, dt)
+        args[name] = shared if shared is not None else nd_zeros(shape, ctx, dt)
         if req.get(name, "null") != "null":
-            args_grad[name] = nd_zeros(shape, ctx, dt)
+            shared = _reusable(sh_grads, name, shape, dt)
+            args_grad[name] = (shared if shared is not None
+                               else nd_zeros(shape, ctx, dt))
     aux = {}
     for name, shape, dt in zip(aux_names, aux_shapes, aux_types):
-        aux[name] = nd_zeros(shape, ctx, dt)
+        shared = _reusable(sh_aux, name, shape, dt)
+        aux[name] = shared if shared is not None else nd_zeros(shape, ctx, dt)
     return Executor(symbol, ctx, args, args_grad or None, req, aux or None,
                     group2ctx=group2ctx, shared_exec=shared_exec)

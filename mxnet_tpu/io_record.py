@@ -68,6 +68,9 @@ class ImageRecordIter(DataIter):
             raise MXNetError(f"ImageRecordIter: no such file {path_imgrec!r}")
         assert len(data_shape) == 3, "data_shape must be (C, H, W)"
         assert 0 <= part_index < num_parts
+        if data_shape[0] == 1 and (random_h or random_s or random_l):
+            raise MXNetError("HSL jitter (random_h/s/l) requires 3-channel "
+                             "data_shape")
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self.shuffle = shuffle
@@ -79,8 +82,12 @@ class ImageRecordIter(DataIter):
         self._epoch = 0
         self._rng = np.random.RandomState(seed)
         self._path_imgrec = path_imgrec
-        # one reader per decode thread: seek+read is stateful
+        # one reader per decode thread: seek+read is stateful.  All
+        # created readers are also tracked here so close() can release
+        # the file handles without waiting for thread-local GC.
         self._tls = threading.local()
+        self._readers = []
+        self._readers_lock = threading.Lock()
 
         # --- record offsets, sharded across workers -------------------
         if path_imgidx and os.path.isfile(path_imgidx):
@@ -135,6 +142,8 @@ class ImageRecordIter(DataIter):
         if rec is None:
             rec = rio.MXRecordIO(self._path_imgrec, "r")
             self._tls.record = rec
+            with self._readers_lock:
+                self._readers.append(rec)
         rec.seek(int(offset))
         s = rec.read()
         if s is None:
@@ -155,6 +164,8 @@ class ImageRecordIter(DataIter):
         rng = _pyrandom.Random(hash((self._seed, self._epoch, int(offset))))
         for aug in self._auglist:
             img = aug(img, rng)
+            if img.ndim == 2:
+                img = img[:, :, None]  # cv2 ops drop the dim of (H,W,1)
         label = header.label
         if isinstance(label, np.ndarray):
             label = label[:self.label_width]
@@ -241,7 +252,11 @@ class ImageRecordIter(DataIter):
                          provide_label=self.provide_label)
 
     def close(self):
-        self._pool.shutdown(wait=False)
+        self._pool.shutdown(wait=True)
+        with self._readers_lock:
+            for rec in self._readers:
+                rec.close()
+            self._readers.clear()
 
     def __del__(self):
         try:

@@ -287,7 +287,32 @@ class Module(BaseModule):
                                             allow_extra_params=True)
 
         if shared_module is not None and shared_module.params_initialized:
-            self.set_params(*shared_module.get_params())
+            # simple_bind already reused the donor's param NDArray objects
+            # (one storage across bucketed executors).  Params must match
+            # the donor exactly — a missing or shape-changed parameter
+            # would silently train from zeros / diverge from the shared
+            # storage, so fail loudly instead.
+            donor = shared_module._exec
+            for n in self._param_names:
+                arr = self._exec.arg_dict[n]
+                donor_arr = donor.arg_dict.get(n)
+                if donor_arr is None:
+                    raise MXNetError(
+                        f"shared_module is missing parameter {n!r}; "
+                        "parameters must be identical across shared modules")
+                if arr is not donor_arr:
+                    raise MXNetError(
+                        f"parameter {n!r} ({arr.shape}/{arr.dtype}) does not "
+                        f"match the shared module's ({donor_arr.shape}/"
+                        f"{donor_arr.dtype}); bucket-specific parameter "
+                        "shapes are not supported")
+            for n in self._aux_names:
+                arr = self._exec.aux_dict[n]
+                donor_arr = donor.aux_dict.get(n)
+                if donor_arr is not None and arr is not donor_arr \
+                        and tuple(arr.shape) == tuple(donor_arr.shape):
+                    arr[:] = donor_arr
+            self.params_initialized = True
 
     def _apply_mesh_plan(self):
         """Pin every executor array to its mesh placement: inputs batch-
@@ -376,6 +401,40 @@ class Module(BaseModule):
             self._preload_opt_states = None
 
     # ------------------------------------------------------------------
+    def borrow_optimizer(self, shared_module):
+        """Share one optimizer across modules — the BucketingModule
+        mechanism (reference: module.py borrow_optimizer)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
+    def _adopt_fused_state(self, other):
+        """Take over the device-resident optimizer state (momentum/Adam
+        slots, step counter, PRNG key) from the previously-active bucket
+        module so training state is continuous across buckets.  The
+        caller must stop using ``other`` as the active module: after the
+        next donated step its references are stale."""
+        if other is self:
+            return
+        self._step_count = other._step_count
+        if other._fused_step is None:
+            return  # nothing device-resident was built yet
+        if self._fused_step is None:
+            # build only the jitted programs; the state slots come from
+            # the donor (allocating fresh ones here would be dead work)
+            self._grad_param_names = [
+                n for n in self._param_names
+                if self._exec.grad_req.get(n, "null") != "null"]
+            self._fused_step = self._build_fused_step()
+            self._apply_grads = self._build_apply_grads()
+        self._fused_state = other._fused_state
+        self._fused_t = other._fused_t
+        self._fused_key = other._fused_key
+        self._lr_cache = other._lr_cache
+
     def forward(self, data_batch, is_train=None):
         """reference: module.py forward → executor forward"""
         assert self.binded and self.params_initialized

@@ -1,0 +1,193 @@
+"""BucketingModule / SequentialModule / PythonModule tests.
+
+Models the reference's bucketing usage (example/rnn/lstm_bucketing.py +
+module tests): variable-length LSTM LM over ≥3 buckets with one shared
+parameter storage and one optimizer; module chaining; python loss.
+"""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch, DataDesc
+
+V, E, H = 16, 8, 16
+BATCH = 8
+BUCKETS = [4, 6, 8]
+
+
+def _lstm_lm_sym(seq_len):
+    """Embedding -> LSTM -> per-step softmax over the vocab."""
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=V, output_dim=E, name="embed")
+    tnc = mx.sym.transpose(embed, axes=(1, 0, 2))
+    rnn = mx.sym.RNN(data=tnc, parameters=mx.sym.Variable("rnn_parameters"),
+                     state=mx.sym.Variable("rnn_s"),
+                     state_cell=mx.sym.Variable("rnn_c"),
+                     state_size=H, num_layers=1, mode="lstm", name="rnn")
+    btc = mx.sym.transpose(rnn, axes=(1, 0, 2))
+    pred = mx.sym.Reshape(btc, shape=(-1, H))
+    pred = mx.sym.FullyConnected(pred, num_hidden=V, name="pred")
+    flat_label = mx.sym.Reshape(label, shape=(-1,))
+    sm = mx.sym.SoftmaxOutput(pred, flat_label, name="softmax")
+    return sm, ("data",), ("softmax_label",)
+
+
+def _bucket_batches(rng, n_per_bucket=6):
+    """Synthetic LM: next token = (tok + 1) % V, variable lengths."""
+    batches = []
+    for seq_len in BUCKETS:
+        for _ in range(n_per_bucket):
+            start = rng.randint(0, V, size=(BATCH, 1))
+            toks = (start + np.arange(seq_len + 1)) % V
+            data = toks[:, :-1].astype(np.float32)
+            label = toks[:, 1:].astype(np.float32)
+            batches.append(DataBatch(
+                [mx.nd.array(data)], [mx.nd.array(label)], pad=0,
+                bucket_key=seq_len,
+                provide_data=[DataDesc("data", (BATCH, seq_len))],
+                provide_label=[DataDesc("softmax_label", (BATCH, seq_len))]))
+    rng.shuffle(batches)
+    return batches
+
+
+def _ce_loss(mod, batch):
+    out = mod.get_outputs()[0].asnumpy()  # (B*T, V)
+    lab = batch.label[0].asnumpy().reshape(-1).astype(int)
+    p = out[np.arange(out.shape[0]), lab]
+    return float(-np.log(np.maximum(p, 1e-9)).mean())
+
+
+def _make_bucketing_module():
+    mod = mx.mod.BucketingModule(_lstm_lm_sym,
+                                 default_bucket_key=max(BUCKETS),
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (BATCH, max(BUCKETS)))],
+             label_shapes=[DataDesc("softmax_label", (BATCH, max(BUCKETS)))])
+    mx.random.seed(7)
+    zeros_s = mx.nd.zeros((1, BATCH, H))
+    mod.init_params(mx.initializer.Uniform(0.1),
+                    arg_params={"rnn_s": zeros_s, "rnn_c": zeros_s.copy()})
+    return mod
+
+
+def test_bucketing_shared_param_buffers():
+    mod = _make_bucketing_module()
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    for b in _bucket_batches(rng, n_per_bucket=1):
+        mod.forward(b, is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets) == set(BUCKETS)
+    default = mod._buckets[max(BUCKETS)]
+    for key in BUCKETS:
+        other = mod._buckets[key]
+        for pname in ("pred_weight", "embed_weight", "rnn_parameters"):
+            assert other._exec.arg_dict[pname] is default._exec.arg_dict[pname], \
+                f"bucket {key} param {pname} is not the shared buffer"
+
+
+def test_bucketing_lstm_perplexity_drops():
+    mod = _make_bucketing_module()
+    mod.init_optimizer(kvstore=None, optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    rng = np.random.RandomState(1)
+    first_losses, last_losses = [], []
+    for epoch in range(8):
+        for b in _bucket_batches(rng, n_per_bucket=3):
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+            loss = _ce_loss(mod, b)
+            if epoch == 0:
+                first_losses.append(loss)
+            elif epoch == 7:
+                last_losses.append(loss)
+    ppl0 = np.exp(np.mean(first_losses))
+    ppl1 = np.exp(np.mean(last_losses))
+    assert ppl1 < ppl0 * 0.5, f"perplexity {ppl0} -> {ppl1} did not drop"
+    # fused step counter is continuous across buckets: one optimizer
+    t = int(np.asarray(mod._curr_module._fused_t))
+    assert t == 8 * 3 * len(BUCKETS), t
+
+
+def test_bucketing_inference_switches():
+    mod = _make_bucketing_module()
+    rng = np.random.RandomState(2)
+    for b in _bucket_batches(rng, n_per_bucket=1):
+        mod.forward(b, is_train=False)
+        out = mod.get_outputs()[0]
+        assert out.shape == (BATCH * b.bucket_key, V)
+
+
+def test_sequential_module_trains():
+    np.random.seed(3)
+    rng = np.random.RandomState(3)
+    X = rng.randn(200, 10).astype(np.float32)
+    yv = np.argmax(X @ rng.randn(10, 3), axis=1).astype(np.float32)
+
+    d1 = mx.sym.Variable("data")
+    feat = mx.sym.Activation(
+        mx.sym.FullyConnected(d1, num_hidden=24, name="fc1"),
+        act_type="relu", name="relu1")
+    m1 = mx.mod.Module(feat, label_names=None, context=mx.cpu())
+
+    d2 = mx.sym.Variable("data")
+    head = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(d2, num_hidden=3, name="fc2"), name="softmax")
+    m2 = mx.mod.Module(head, context=mx.cpu())
+
+    seq = mx.mod.SequentialModule()
+    seq.add(m1).add(m2, take_labels=True, auto_wiring=True)
+    it = mx.io.NDArrayIter(X, yv, batch_size=20, shuffle=True)
+    seq.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3})
+    score = seq.score(mx.io.NDArrayIter(X, yv, batch_size=20), "acc")
+    assert score[0][1] > 0.85, score
+
+
+def test_python_loss_module():
+    np.random.seed(4)
+    rng = np.random.RandomState(4)
+    X = rng.randn(120, 6).astype(np.float32)
+    yv = (X.sum(axis=1) > 0).astype(np.float32)
+
+    d = mx.sym.Variable("data")
+    logits = mx.sym.FullyConnected(d, num_hidden=2, name="fc")
+    m1 = mx.mod.Module(logits, label_names=None, context=mx.cpu())
+
+    def ce_grad(scores, labels):
+        s = scores.asnumpy()
+        lab = labels.asnumpy().astype(int)
+        p = np.exp(s - s.max(axis=1, keepdims=True))
+        p /= p.sum(axis=1, keepdims=True)
+        p[np.arange(len(lab)), lab] -= 1.0
+        return p / len(lab)
+
+    loss = mx.mod.PythonLossModule(grad_func=ce_grad)
+    seq = mx.mod.SequentialModule()
+    seq.add(m1).add(loss, take_labels=True, auto_wiring=True)
+    it = mx.io.NDArrayIter(X, yv, batch_size=20)
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params(mx.initializer.Uniform(0.1))
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5,
+                                         "rescale_grad": 1.0})
+    for _ in range(12):
+        it.reset()
+        for b in it:
+            seq.forward(b, is_train=True)
+            seq.backward()
+            seq.update()
+    # accuracy from the logits module
+    it.reset()
+    correct = n = 0
+    for b in it:
+        seq.forward(b, is_train=False)
+        pred = seq.get_outputs()[0].asnumpy().argmax(axis=1)
+        lab = b.label[0].asnumpy().astype(int)
+        correct += (pred == lab).sum()
+        n += len(lab)
+    assert correct / n > 0.9, correct / n

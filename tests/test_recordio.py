@@ -273,3 +273,26 @@ def test_image_record_iter_rejects_unknown_kwargs(tmp_path):
         mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
                               data_shape=(3, 32, 32), batch_size=2,
                               rand_miror=True)
+
+
+def test_image_record_iter_grayscale_resize(tmp_path):
+    """cv2 ops drop the channel dim of (H,W,1); the pipeline must restore it."""
+    prefix = str(tmp_path / "gr")
+    rec = rio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = (rng.rand(20, 20) * 255).astype(np.uint8)  # != data_shape
+        rec.write_idx(i, rio.pack_img(rio.IRHeader(0, float(i % 2), i, 0),
+                                      img, img_fmt=".png"))
+    rec.close()
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", data_shape=(1, 28, 28), batch_size=4,
+        rand_crop=True, rand_mirror=True, max_rotate_angle=10,
+        preprocess_threads=2)
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 1, 28, 28)
+    it.close()
+    with pytest.raises(Exception, match="HSL"):
+        mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                              data_shape=(1, 28, 28), batch_size=4,
+                              random_h=10)
