@@ -42,6 +42,11 @@ from . import kvstore as kv
 from . import model
 from . import module
 from . import module as mod
+from . import monitor
+from .monitor import Monitor
+from . import profiler
+from . import visualization
+from . import visualization as viz
 
 __all__ = [
     "MXNetError", "Context", "cpu", "gpu", "tpu", "current_context",

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import profiler as _prof
 from .base import MXNetError
 from .context import Context
 from .ndarray import NDArray, zeros as nd_zeros
@@ -223,21 +224,25 @@ class Executor:
         if self._monitor_callback is not None:
             self._run_monitor(arg_vals, aux_vals, rng, is_train)
 
-        if is_train and self._grad_names and self._outputs_all_loss_heads():
-            # training step on a loss-head graph: run the single fused
-            # fwd+bwd program now and cache the grads — backward() then
-            # just writes them out, so fwd+bwd costs ONE program run
-            outs, new_aux, grads = self._jit_fused_ones(arg_vals, aux_vals, rng)
-            self._cached_grads = grads
-            self._train_snapshot = (arg_vals, aux_vals, rng)
-        else:
-            fn = self._jit_fwd_train if is_train else self._jit_fwd
-            outs, new_aux = fn(arg_vals, aux_vals, rng)
-            if is_train and self._grad_names:
-                # stash the *pristine* inputs + rng so a later
-                # backward(out_grads) reproduces this forward exactly
-                # (same dropout masks, same pre-update aux)
+        with _prof.scope("Executor.forward/train" if is_train
+                         else "Executor.forward", cat="exec"):
+            if is_train and self._grad_names and self._outputs_all_loss_heads():
+                # training step on a loss-head graph: run the single fused
+                # fwd+bwd program now and cache the grads — backward() then
+                # just writes them out, so fwd+bwd costs ONE program run
+                outs, new_aux, grads = self._jit_fused_ones(arg_vals, aux_vals, rng)
+                self._cached_grads = grads
                 self._train_snapshot = (arg_vals, aux_vals, rng)
+            else:
+                fn = self._jit_fwd_train if is_train else self._jit_fwd
+                outs, new_aux = fn(arg_vals, aux_vals, rng)
+                if is_train and self._grad_names:
+                    # stash the *pristine* inputs + rng so a later
+                    # backward(out_grads) reproduces this forward exactly
+                    # (same dropout masks, same pre-update aux)
+                    self._train_snapshot = (arg_vals, aux_vals, rng)
+            if _prof._profiler.running:
+                jax.block_until_ready(outs)  # real span, not dispatch time
         for name, val in new_aux.items():
             self.aux_dict[name]._set_data(val)
         self.outputs_cache = [NDArray(o, self._ctx) for o in outs]
@@ -275,7 +280,10 @@ class Executor:
                 raise MXNetError(
                     f"out_grads has {len(heads)} entries for "
                     f"{len(self.output_names)} outputs")
-            _, _, grads = self._jit_fused(arg_vals, aux_vals, rng, heads)
+            with _prof.scope("Executor.backward", cat="exec"):
+                _, _, grads = self._jit_fused(arg_vals, aux_vals, rng, heads)
+                if _prof._profiler.running:
+                    jax.block_until_ready(grads)
         for name in self._grad_names:
             g = grads[name]
             dst = self.grad_dict[name]

@@ -41,9 +41,12 @@ class BucketingModule(BaseModule):
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
+        self._monitor = None
 
     def _reset_bind(self):
         self.binded = False
+        self.optimizer_initialized = False  # the rebound module needs a
+        # fresh init_optimizer; leaving the flag set made update() assert
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
@@ -148,6 +151,8 @@ class BucketingModule(BaseModule):
                         force_rebind=False,
                         shared_module=self._buckets[self._default_bucket_key],
                         grad_req=self._grad_req)
+            if self._monitor is not None:
+                module.install_monitor(self._monitor)
             self._buckets[bucket_key] = module
         if bucket_key != self._curr_bucket_key:
             prev = self._curr_module
@@ -207,6 +212,7 @@ class BucketingModule(BaseModule):
 
     def install_monitor(self, mon):
         assert self.binded
+        self._monitor = mon  # buckets created later get it too
         for mod in self._buckets.values():
             mod.install_monitor(mon)
 

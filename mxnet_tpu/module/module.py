@@ -22,6 +22,7 @@ import numpy as np
 
 from .. import ndarray as nd
 from .. import optimizer as opt
+from .. import profiler as _prof
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
 from ..initializer import Uniform
@@ -498,6 +499,8 @@ class Module(BaseModule):
     # -- fused one-program training step --------------------------------
     def _fused_ready(self):
         return (self._use_fused and self.optimizer_initialized
+                and self._exec._monitor_callback is None  # monitored runs
+                # must go through Executor.forward so the tap fires
                 and not self.inputs_need_grad
                 and not self._update_on_kvstore
                 and (self._kvstore is None
@@ -689,9 +692,13 @@ class Module(BaseModule):
         params = _copy_donated_aliases(
             params, _buffer_ids(fixed, aux, inputs, self._fused_state,
                                 self._fused_key, self._fused_t))
-        outs, new_params, new_aux, new_states, self._fused_t = self._fused_step(
-            params, fixed, aux, self._fused_state, inputs, self._fused_key,
-            lr_dev, self._fused_t)
+        with _prof.scope("Module.fused_step", cat="exec"):
+            outs, new_params, new_aux, new_states, self._fused_t = \
+                self._fused_step(params, fixed, aux, self._fused_state,
+                                 inputs, self._fused_key, lr_dev,
+                                 self._fused_t)
+            if _prof._profiler.running:
+                jax.block_until_ready(outs)
         for n, v in new_params.items():
             self._exec.arg_dict[n]._set_data(v)
         for n, v in new_aux.items():

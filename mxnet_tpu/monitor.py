@@ -1,0 +1,99 @@
+"""Monitor — tensor statistics for debugging (NaN hunting, blowups).
+
+Parity with ``python/mxnet/monitor.py:16``: install on executors, per-
+interval collection of a statistic over every op output (via the
+executor monitor tap) plus weights/aux states, regex filtering,
+``tic``/``toc_print`` around each batch.
+
+TPU note: the executor tap runs a second jitted internals program for
+the monitored forward (documented 2x cost — debugging only); weight
+stats are computed on device through the normal imperative ops and
+only the scalar results transfer to host.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from math import sqrt
+
+from . import ndarray
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """reference: monitor.py Monitor"""
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                """|x|_2 / sqrt(size) — the reference default."""
+                return ndarray.norm(x) / sqrt(x.size)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(array)))
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Install the tap on an executor (multiple allowed)."""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch; call before forward."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting and return [(step, name, stat_str)]."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for name, array in zip(exe._symbol.list_arguments(),
+                                   exe.arg_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+            for name, array in zip(exe._symbol.list_auxiliary_states(),
+                                   exe.aux_arrays):
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ""
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.size == 1:
+                    s += str(v.asscalar()) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Stop collecting and log the results."""
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -1,0 +1,140 @@
+"""Profiler — Chrome-trace timing + XLA trace passthrough.
+
+Capability parity with the reference profiler (``src/engine/
+profiler.h:20-130`` per-op stats dumped as Chrome tracing JSON,
+controlled from ``python/mxnet/profiler.py``): same control surface
+(``profiler_set_config`` / ``profiler_set_state`` / ``dump_profile``),
+same output format (``chrome://tracing`` JSON).
+
+TPU-first split: per-*kernel* timing lives in XLA, exposed by wrapping
+``jax.profiler`` (``start_xla_trace``/``stop_xla_trace`` write a full
+XPlane/TensorBoard trace — the modern equivalent of per-op stats);
+this module's own events time the *host-visible program units* the
+framework actually dispatches (forward / backward / fused step /
+update / io), which is the granularity a single-XLA-program design
+has.  Framework internals mark spans with ``profiler.scope(name)`` —
+a no-op when profiling is off.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+__all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
+           "scope", "start_xla_trace", "stop_xla_trace", "Profiler"]
+
+
+class Profiler:
+    """Collects Chrome-trace 'X' (complete) events."""
+
+    def __init__(self):
+        self._events = []
+        self._lock = threading.Lock()
+        self._running = False
+        self._filename = "profile.json"
+        self._mode = "symbolic"  # 'symbolic' | 'all' (reference modes)
+        self._t0 = time.perf_counter()
+
+    # -- control (reference: profiler.py profiler_set_config/state) ----
+    def set_config(self, mode="symbolic", filename="profile.json"):
+        assert mode in ("symbolic", "all")
+        self._mode = mode
+        self._filename = filename
+
+    def set_state(self, state="stop"):
+        assert state in ("run", "stop")
+        was = self._running
+        self._running = state == "run"
+        if was and not self._running and self._filename:
+            self.dump(self._filename)
+
+    @property
+    def running(self):
+        return self._running
+
+    # -- event recording -----------------------------------------------
+    def add_event(self, name, start_s, dur_s, cat="op", tid=None):
+        if not self._running:
+            return
+        with self._lock:
+            self._events.append({
+                "name": name, "cat": cat, "ph": "X",
+                "ts": (start_s - self._t0) * 1e6, "dur": dur_s * 1e6,
+                "pid": os.getpid(),
+                "tid": tid if tid is not None else threading.get_ident(),
+            })
+
+    def scope(self, name, cat="op"):
+        # shared null context when off: zero allocation on the hot path
+        if not self._running:
+            return _NULL_CTX
+        return self._span(name, cat)
+
+    @contextmanager
+    def _span(self, name, cat):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_event(name, start, time.perf_counter() - start, cat)
+
+    def dump(self, filename=None):
+        """Write accumulated events as Chrome tracing JSON."""
+        filename = filename or self._filename
+        with self._lock:
+            events = list(self._events)
+        with open(filename, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        return filename
+
+
+_NULL_CTX = contextlib.nullcontext()
+
+_profiler = Profiler()
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """reference: python/mxnet/profiler.py profiler_set_config"""
+    _profiler.set_config(mode=mode, filename=filename)
+
+
+def profiler_set_state(state="stop"):
+    """reference: python/mxnet/profiler.py profiler_set_state"""
+    _profiler.set_state(state)
+
+
+def dump_profile(filename=None):
+    """reference: MXDumpProfile"""
+    return _profiler.dump(filename)
+
+
+def scope(name, cat="op"):
+    """Span context manager used by framework internals; no-op when off."""
+    return _profiler.scope(name, cat)
+
+
+# -- XLA-level tracing (the per-kernel story) ---------------------------
+def start_xla_trace(logdir):
+    """Start a jax.profiler trace (XPlane; view in TensorBoard/Perfetto).
+
+    This is where TPU per-kernel timing lives — the XLA-era equivalent
+    of the reference's per-op OprExecStat."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def stop_xla_trace():
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+# env autostart (reference: MXNET_PROFILER_AUTOSTART, env_var.md:63-72)
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    profiler_set_state("run")

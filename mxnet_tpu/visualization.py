@@ -1,0 +1,225 @@
+"""Network visualization: ``print_summary`` + ``plot_network``.
+
+Parity with ``python/mxnet/visualization.py:1-311`` over this
+framework's symbol JSON (same NNVM node-list format): a layer-table
+summary with shapes/params and a graphviz network plot.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from .base import MXNetError
+from .symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _node_attrs(node):
+    """Op attrs across JSON vintages ('attrs' here, 'attr'/'param' legacy)."""
+    return node.get("attrs") or node.get("attr") or node.get("param") or {}
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(.44, .64, .74, 1.)):
+    """Print a layer-by-layer summary table (reference:
+    visualization.py:29 print_summary)."""
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(internals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = set(conf["heads"][0])
+    positions = [int(line_length * p) if p <= 1 else int(p)
+                 for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    lines = []
+
+    def print_row(fields):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        lines.append(line)
+
+    lines.append("_" * line_length)
+    print_row(to_display)
+    lines.append("=" * line_length)
+
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+                    if show_shape:
+                        key = input_name + "_output" \
+                            if input_node["op"] != "null" else input_name
+                        if key in shape_dict:
+                            pre_filter += int(shape_dict[key][1]) \
+                                if len(shape_dict[key]) > 1 else 0
+        cur_param = 0
+        attrs = _node_attrs(node)
+        if op == "Convolution":
+            num_filter = int(attrs["num_filter"])
+            kernel = re.findall(r"\d+", attrs["kernel"])
+            cur_param = pre_filter * num_filter
+            for k in kernel:
+                cur_param *= int(k)
+            if attrs.get("no_bias", "False") not in ("True", "1", "true"):
+                cur_param += num_filter
+        elif op == "FullyConnected":
+            num_hidden = int(attrs["num_hidden"])
+            cur_param = pre_filter * num_hidden
+            if attrs.get("no_bias", "False") not in ("True", "1", "true"):
+                cur_param += num_hidden
+        elif op == "BatchNorm":
+            key = node["name"] + "_output"
+            if show_shape and key in shape_dict:
+                cur_param = int(shape_dict[key][1]) * 4
+        first_connection = pre_node[0] if pre_node else ""
+        fields = [f"{node['name']}({op})",
+                  "x".join(str(x) for x in out_shape) if out_shape else "",
+                  cur_param, first_connection]
+        print_row(fields)
+        for connection in pre_node[1:]:
+            print_row(["", "", "", connection])
+        total_params[0] += cur_param
+
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            if show_shape:
+                key = node["name"] + "_output" if op != "null" \
+                    else node["name"]
+                if key in shape_dict:
+                    out_shape = shape_dict[key][1:]
+        print_layer_summary(node, out_shape)
+        lines.append(("=" if i == len(nodes) - 1 else "_") * line_length)
+    lines.append(f"Total params: {total_params[0]}")
+    lines.append("_" * line_length)
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None):
+    """Build a graphviz Digraph of the network (reference:
+    visualization.py:167 plot_network)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires the graphviz package")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    draw_shape = False
+    shape_dict = {}
+    if shape is not None:
+        draw_shape = True
+        internals = symbol.get_internals()
+        _, out_shapes, _ = internals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(internals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs or {})
+    dot = Digraph(name=title, format=save_format)
+
+    # color palette per op family (reference palette)
+    cm = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3",
+          "#fdb462", "#b3de69", "#fccde5")
+
+    def looks_like_weight(name):
+        return (name.endswith("_weight") or name.endswith("_bias")
+                or name.endswith("_gamma") or name.endswith("_beta")
+                or name.endswith("_moving_mean")
+                or name.endswith("_moving_var")
+                or name.endswith("_parameters")
+                or name.endswith("_s") or name.endswith("_c"))
+
+    hidden_nodes = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        attrs = _node_attrs(node)
+        label = name
+        attr = dict(node_attr)
+        if op == "null":
+            if looks_like_weight(name):
+                hidden_nodes.add(name)
+                continue
+            attr["shape"] = "oval"
+            attr["fillcolor"] = cm[0]
+        elif op == "Convolution":
+            kernel = "x".join(re.findall(r"\d+", attrs["kernel"]))
+            stride = "x".join(re.findall(r"\d+", attrs.get("stride", "(1,1)")))
+            label = f"Convolution\n{kernel}/{stride}, {attrs['num_filter']}"
+            attr["fillcolor"] = cm[1]
+        elif op == "FullyConnected":
+            label = f"FullyConnected\n{attrs['num_hidden']}"
+            attr["fillcolor"] = cm[1]
+        elif op == "BatchNorm":
+            attr["fillcolor"] = cm[3]
+        elif op == "Activation" or op == "LeakyReLU":
+            label = f"{op}\n{attrs.get('act_type', '')}"
+            attr["fillcolor"] = cm[2]
+        elif op == "Pooling":
+            kernel = "x".join(re.findall(r"\d+", attrs.get("kernel", "()")))
+            stride = "x".join(re.findall(r"\d+", attrs.get("stride", "(1,1)")))
+            label = f"Pooling\n{attrs.get('pool_type','')}, {kernel}/{stride}"
+            attr["fillcolor"] = cm[4]
+        elif op in ("Concat", "Flatten", "Reshape"):
+            attr["fillcolor"] = cm[5]
+        elif op == "Softmax" or op == "SoftmaxOutput":
+            attr["fillcolor"] = cm[6]
+        else:
+            attr["fillcolor"] = cm[7]
+        dot.node(name=name, label=label, **attr)
+
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        inputs = node["inputs"]
+        for item in inputs:
+            input_node = nodes[item[0]]
+            input_name = input_node["name"]
+            if input_name in hidden_nodes:
+                continue
+            attr = {"dir": "back", "arrowtail": "open"}
+            if draw_shape:
+                key = (input_name + "_output" if input_node["op"] != "null"
+                       else input_name)
+                if key in shape_dict:
+                    attr["label"] = "x".join(
+                        str(x) for x in shape_dict[key][1:])
+            dot.edge(tail_name=name, head_name=input_name, **attr)
+    return dot

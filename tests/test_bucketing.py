@@ -191,3 +191,26 @@ def test_python_loss_module():
         correct += (pred == lab).sum()
         n += len(lab)
     assert correct / n > 0.9, correct / n
+
+
+def test_bucketing_force_rebind_resumes_training():
+    mod = _make_bucketing_module()
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(5)
+    batches = _bucket_batches(rng, n_per_bucket=1)
+    for b in batches[:3]:
+        mod.forward(b, is_train=True); mod.backward(); mod.update()
+    w_before = mod.get_params()[0]["pred_weight"].asnumpy().copy()
+    mod.bind(data_shapes=[DataDesc("data", (BATCH, max(BUCKETS)))],
+             label_shapes=[DataDesc("softmax_label", (BATCH, max(BUCKETS)))],
+             force_rebind=True)
+    # params survived the rebind
+    np.testing.assert_allclose(mod.get_params()[0]["pred_weight"].asnumpy(),
+                               w_before)
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for b in batches[:3]:
+        mod.forward(b, is_train=True); mod.backward(); mod.update()
+    assert not np.allclose(mod.get_params()[0]["pred_weight"].asnumpy(),
+                           w_before)

@@ -1,0 +1,143 @@
+"""Monitor / profiler / visualization tests (reference: monitor usage
+in docs, test_viz.py, profiler dump format)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_monitor_collects_stats():
+    np.random.seed(0)
+    X = np.random.randn(40, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=10)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mon = mx.Monitor(interval=1, pattern=".*")
+    mod.install_monitor(mon)
+    b = next(iter(it))
+    mon.tic()
+    mod.forward(b, is_train=False)
+    res = mon.toc()
+    names = [k for _, k, _ in res]
+    assert any("fc" in n for n in names), names
+    assert any("weight" in n for n in names), names  # weights stat'd too
+    for _, _, v in res:
+        assert "nan" not in v.lower()
+
+
+def test_monitor_finds_nan():
+    """The NaN-hunt workflow: a poisoned weight shows up in the stats."""
+    sym = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    bad = mod._exec.arg_dict["fc_weight"].asnumpy().copy()
+    bad[0, 0] = np.nan
+    mod._exec.arg_dict["fc_weight"][:] = bad
+    mon = mx.Monitor(interval=1, pattern=".*fc.*")
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(mx.io.DataBatch([mx.nd.zeros((4, 6))],
+                                [mx.nd.zeros((4,))]), is_train=False)
+    res = mon.toc()
+    assert any("nan" in v.lower() for _, _, v in res), res
+
+
+def test_profiler_chrome_trace(tmp_path):
+    fname = str(tmp_path / "trace.json")
+    mx.profiler.profiler_set_config(mode="all", filename=fname)
+    mx.profiler.profiler_set_state("run")
+    X = np.random.randn(30, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=10)
+    mod = mx.mod.Module(_mlp_binary(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd")
+    mx.profiler.profiler_set_state("stop")
+    assert os.path.isfile(fname)
+    with open(fname) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert len(events) > 0
+    names = {e["name"] for e in events}
+    assert any("fused_step" in n or "forward" in n for n in names), names
+    for e in events:
+        assert e["ph"] == "X" and "ts" in e and "dur" in e
+
+
+def _mlp_binary():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_print_summary():
+    out = mx.viz.print_summary(_mlp(), shape={"data": (8, 6)})
+    assert "fc1(FullyConnected)" in out
+    assert "Total params" in out
+    # fc1: 6*8+8 = 56; fc2: 8*3+3 = 27
+    assert "Total params: 83" in out
+
+
+def test_plot_network():
+    dot = mx.viz.plot_network(_mlp(), shape={"data": (8, 6)},
+                              save_format="dot")
+    src = dot.source
+    assert "fc1" in src and "relu1" in src and "softmax" in src
+    assert "fc1_weight" not in src  # weights hidden
+    assert "->" in src or "--" in src
+
+
+def test_xla_trace_smoke(tmp_path):
+    """jax.profiler passthrough writes an XPlane trace directory."""
+    logdir = str(tmp_path / "xla")
+    mx.profiler.start_xla_trace(logdir)
+    mx.nd.dot(mx.nd.ones((32, 32)), mx.nd.ones((32, 32))).asnumpy()
+    mx.profiler.stop_xla_trace()
+    found = []
+    for root, _, files in os.walk(logdir):
+        found.extend(files)
+    assert found, "no trace files written"
+
+
+def test_monitor_fires_during_training():
+    """The fused path must yield to the tap: training forwards are monitored."""
+    np.random.seed(1)
+    X = np.random.randn(20, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=10)
+    mod = mx.mod.Module(_mlp_binary(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    mon = mx.Monitor(interval=1, pattern=".*output.*")
+    mod.install_monitor(mon)
+    b = next(iter(it))
+    mon.tic()
+    mod.forward(b, is_train=True)
+    mod.backward()
+    mod.update()
+    res = mon.toc()
+    assert any("output" in k for _, k, _ in res), res
