@@ -42,6 +42,7 @@ from . import kvstore as kv
 from . import model
 from . import module
 from . import module as mod
+from . import operator
 from . import monitor
 from .monitor import Monitor
 from . import profiler

@@ -1,0 +1,176 @@
+"""The ``Custom`` operator — user Python ops inside the compiled graph.
+
+Capability parity with the reference custom-op machinery
+(``src/operator/custom-inl.h`` trampoline + the Python surface in
+``python/mxnet/operator.py:396-580``): a ``CustomOpProp`` subclass
+registered under a name, instantiated per node, supplying shape/type
+inference and a ``CustomOp`` whose ``forward``/``backward`` run host
+Python over NDArrays.
+
+TPU-native mapping: the host code is injected into the XLA program via
+``jax.pure_callback`` and differentiates through ``jax.custom_vjp`` —
+forward calls ``CustomOp.forward``, the VJP calls ``CustomOp.backward``
+with the saved inputs/outputs.  The callback runs on the host CPU while
+the surrounding program stays compiled; auxiliary states round-trip
+through the callback (mutation-in-place becomes value-out, matching the
+framework's functional aux handling).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .registry import register
+
+# name -> CustomOpProp subclass (filled by mxnet_tpu.operator.register)
+_PROPS: Dict[str, type] = {}
+
+
+# attrs the framework may add around user kwargs
+_FRAMEWORK_ATTRS = ("op_type", "num_args", "name", "ctx", "is_train", "out")
+
+
+@functools.lru_cache(maxsize=1024)
+def _cached_prop(op_type, kwarg_items):
+    cls = _PROPS.get(op_type)
+    if cls is None:
+        raise MXNetError(f"custom op type {op_type!r} is not registered "
+                         "(use mxnet_tpu.operator.register)")
+    return cls(**dict(kwarg_items))
+
+
+def _make_prop(attrs):
+    """One CustomOpProp per (op_type, user kwargs) — memoized, mirroring
+    the reference's one-prop-per-node lifetime."""
+    op_type = attrs.get("op_type")
+    if op_type is None:
+        raise MXNetError("Custom op requires an op_type attr")
+    kwargs = tuple(sorted((k, v) for k, v in attrs.items()
+                          if k not in _FRAMEWORK_ATTRS))
+    return _cached_prop(op_type, kwargs)
+
+
+def _custom_arg_names(attrs):
+    return [str(n) for n in _make_prop(attrs).list_arguments()]
+
+
+def _custom_aux_names(attrs):
+    return [str(n) for n in _make_prop(attrs).list_auxiliary_states()]
+
+
+def _custom_out_names(attrs):
+    return [str(n) for n in _make_prop(attrs).list_outputs()]
+
+
+def _custom_infer_shape(attrs, in_shapes):
+    prop = _make_prop(attrs)
+    if any(s is None for s in in_shapes):
+        return in_shapes, None, None
+    ins, outs, auxs = prop.infer_shape([list(s) for s in in_shapes])
+    return ([tuple(s) for s in ins], [tuple(s) for s in outs],
+            [tuple(s) for s in (auxs or [])])
+
+
+def _nd_wrap(np_arrays):
+    """Host numpy -> framework NDArrays pinned to cpu (what CustomOp
+    code expects to receive)."""
+    from .. import ndarray as nd
+    from ..context import cpu
+
+    return [nd.array(np.asarray(a), ctx=cpu()) for a in np_arrays]
+
+
+@register("Custom",
+          arg_names=_custom_arg_names,
+          aux_names=_custom_aux_names,
+          out_names=_custom_out_names,
+          infer_shape=_custom_infer_shape,
+          doc="Apply a registered CustomOp (reference: operator.py Custom)")
+def _custom_compute(op_ctx, attrs, inputs, aux):
+    prop = _make_prop(attrs)
+    in_shapes = [tuple(x.shape) for x in inputs]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    try:
+        _, out_types, _ = prop.infer_type([x.dtype for x in inputs])
+    except Exception:
+        base = inputs[0].dtype if inputs else jnp.float32
+        out_types = [base] * len(out_shapes)
+    n_out = len(out_shapes)
+    n_in = len(inputs)
+    n_aux = len(aux)
+    is_train = bool(op_ctx.is_train)
+    out_specs = tuple(jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+                      for s, d in zip(out_shapes, out_types))
+    aux_specs = tuple(jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                      for a in aux)
+    in_specs = tuple(jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+                     for x in inputs)
+
+    # one stateful CustomOp instance per node execution context — the
+    # reference keeps one Operator per executor node the same way
+    holder = {}
+
+    def _op():
+        if "op" not in holder:
+            holder["op"] = prop.create_operator(None, [list(s) for s in in_shapes])
+        return holder["op"]
+
+    def host_forward(*arrs):
+        ins = _nd_wrap(arrs[:n_in])
+        auxs = _nd_wrap(arrs[n_in:])
+        from .. import ndarray as nd
+        from ..context import cpu
+
+        outs = [nd.zeros(tuple(s), ctx=cpu(), dtype=np.dtype(d))
+                for s, d in zip(out_shapes, out_types)]
+        _op().forward(is_train, ["write"] * n_out, ins, outs, auxs)
+        return (tuple(o.asnumpy() for o in outs)
+                + tuple(a.asnumpy() for a in auxs))
+
+    def host_backward(*arrs):
+        ins = _nd_wrap(arrs[:n_in])
+        outs = _nd_wrap(arrs[n_in:n_in + n_out])
+        ograds = _nd_wrap(arrs[n_in + n_out:n_in + 2 * n_out])
+        auxs = _nd_wrap(arrs[n_in + 2 * n_out:])
+        from .. import ndarray as nd
+        from ..context import cpu
+
+        igrads = [nd.zeros(tuple(x.shape), ctx=cpu(),
+                           dtype=np.dtype(x.dtype)) for x in ins]
+        _op().backward(["write"] * n_in, ograds, ins, outs, igrads, auxs)
+        return tuple(g.asnumpy() for g in igrads)
+
+    @jax.custom_vjp
+    def f(ins, auxs):
+        res = jax.pure_callback(host_forward, out_specs + aux_specs,
+                                *ins, *auxs)
+        return tuple(res[:n_out]), tuple(res[n_out:])
+
+    def f_fwd(ins, auxs):
+        outs, new_aux = f(ins, auxs)
+        # residuals carry the POST-forward aux: backward must see the
+        # state forward wrote (reference aux are shared in-place buffers)
+        return (outs, new_aux), (ins, outs, new_aux)
+
+    def f_bwd(saved, cots):
+        ins, outs, auxs = saved
+        out_cots = [jnp.zeros(s.shape, s.dtype) if c is None else c
+                    for c, s in zip(cots[0], out_specs)]
+        gins = jax.pure_callback(host_backward, in_specs,
+                                 *ins, *outs, *out_cots, *auxs)
+        if not isinstance(gins, (list, tuple)):
+            gins = (gins,)
+        zero_aux = tuple(jnp.zeros(a.shape, a.dtype) for a in aux_specs)
+        return tuple(gins), zero_aux
+
+    f.defvjp(f_fwd, f_bwd)
+    outs, new_aux = f(tuple(inputs), tuple(aux))
+    if n_aux:
+        return list(outs), list(new_aux)
+    return list(outs)
