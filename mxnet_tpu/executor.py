@@ -197,7 +197,7 @@ class Executor:
             if node.is_variable:
                 return False
             op = get_op(node.op)
-            if not op.is_loss and op.name != "BlockGrad":
+            if not op.loss_head(node.attrs) and op.name != "BlockGrad":
                 return False
         return True
 

@@ -105,6 +105,8 @@ def register(reg_name):
         if not issubclass(prop_cls, CustomOpProp):
             raise MXNetError("register() requires a CustomOpProp subclass")
         _custom._PROPS[reg_name] = prop_cls
+        # re-registration must not serve stale cached prop instances
+        _custom._cached_prop.cache_clear()
         return prop_cls
 
     return do_register

@@ -18,5 +18,7 @@ from . import ordering  # noqa: F401
 from . import nn  # noqa: F401
 from . import rnn  # noqa: F401
 from . import custom  # noqa: F401
+from . import detection  # noqa: F401
+from . import spatial  # noqa: F401
 
 __all__ = ["OpContext", "OpDef", "get_op", "invoke", "list_ops", "register"]

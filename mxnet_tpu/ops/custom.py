@@ -86,21 +86,24 @@ def _nd_wrap(np_arrays):
     return [nd.array(np.asarray(a), ctx=cpu()) for a in np_arrays]
 
 
+def _custom_is_loss(attrs):
+    """need_top_grad=False means the op produces its own gradient — a
+    loss head (reference: declare_backward_dependency semantics)."""
+    return not _make_prop(attrs).need_top_grad_
+
+
 @register("Custom",
           arg_names=_custom_arg_names,
           aux_names=_custom_aux_names,
           out_names=_custom_out_names,
           infer_shape=_custom_infer_shape,
+          is_loss=_custom_is_loss,
           doc="Apply a registered CustomOp (reference: operator.py Custom)")
 def _custom_compute(op_ctx, attrs, inputs, aux):
     prop = _make_prop(attrs)
     in_shapes = [tuple(x.shape) for x in inputs]
     _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
-    try:
-        _, out_types, _ = prop.infer_type([x.dtype for x in inputs])
-    except Exception:
-        base = inputs[0].dtype if inputs else jnp.float32
-        out_types = [base] * len(out_shapes)
+    _, out_types, _ = prop.infer_type([x.dtype for x in inputs])
     n_out = len(out_shapes)
     n_in = len(inputs)
     n_aux = len(aux)

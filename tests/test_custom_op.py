@@ -177,3 +177,37 @@ def test_custom_backward_sees_forward_aux():
     exe.backward(out_grads=[mx.nd.ones((2, 2))])
     np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
                                np.full((2, 2), 7.0))
+
+
+@mxop.register("test_custom_loss")
+class CustomLossProp(mxop.CustomOpProp):
+    """need_top_grad=False: the op is a loss head producing its own grad."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes=None):
+        class L(mxop.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0])
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                # d/dx of 0.5*x^2 — ignores out_grad like reference
+                # loss-style custom ops
+                self.assign(in_grad[0], req[0], in_data[0])
+
+        return L()
+
+
+def test_custom_loss_head_backward_without_out_grads():
+    """The reference custom-loss workflow: backward() with no out_grads."""
+    sym = mx.sym.Custom(mx.sym.Variable("data"),
+                        op_type="test_custom_loss", name="loss")
+    exe = sym.simple_bind(mx.cpu(), grad_req="write", data=(2, 3))
+    x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    exe.forward(is_train=True)
+    exe.backward()  # no out_grads: op is recognized as a loss head
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), x,
+                               rtol=1e-5)

@@ -1,0 +1,255 @@
+"""SSD detection + spatial op tests (numpy references inline, the
+reference's test_operator.py style)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+# ---------------------------------------------------------------- priors
+def test_multibox_prior():
+    data = mx.nd.zeros((1, 3, 2, 2))
+    out = mx.nd.MultiBoxPrior(data, sizes="(0.5, 0.25)", ratios="(1, 2)")
+    # apx = 2 sizes + 2 ratios - 1 = 3; 2x2 pixels
+    assert out.shape == (1, 2 * 2 * 3, 4)
+    a = out.asnumpy()[0]
+    # first anchor: center (0.25, 0.25), size 0.5 -> [0, 0, 0.5, 0.5]
+    np.testing.assert_allclose(a[0], [0.0, 0.0, 0.5, 0.5], atol=1e-6)
+    # second: size 0.25 -> [.125, .125, .375, .375]
+    np.testing.assert_allclose(a[1], [0.125, 0.125, 0.375, 0.375], atol=1e-6)
+    # third: size .5, ratio 2 -> w = .5*sqrt2/2, h = .5/sqrt2/2
+    r = np.sqrt(2.0)
+    np.testing.assert_allclose(
+        a[2], [0.25 - 0.25 * r, 0.25 - 0.25 / r,
+               0.25 + 0.25 * r, 0.25 + 0.25 / r], atol=1e-6)
+
+
+def test_multibox_prior_clip():
+    data = mx.nd.zeros((1, 3, 1, 1))
+    out = mx.nd.MultiBoxPrior(data, sizes="(1.5,)", clip="True").asnumpy()
+    assert out.min() >= 0.0 and out.max() <= 1.0
+
+
+# ---------------------------------------------------------------- target
+def _iou_np(a, b):
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    i = iw * ih
+    u = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - i
+    return i / u if u > 0 else 0.0
+
+
+def test_multibox_target_basic():
+    # 3 anchors, 1 gt that overlaps anchor 0 strongly
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]], np.float32)
+    # one gt: class 2, box ~ anchor 0
+    label = np.array([[[2, 0.05, 0.05, 0.45, 0.55],
+                       [-1, -1, -1, -1, -1]]], np.float32)
+    cls_pred = np.zeros((1, 4, 3), np.float32)
+    loc_t, loc_m, cls_t = [o.asnumpy() for o in mx.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred),
+        overlap_threshold="0.5")]
+    cls_t = cls_t[0]
+    assert cls_t[0] == 3.0  # class 2 + 1 (0 reserved for background)
+    assert cls_t[1] == 0.0 and cls_t[2] == 0.0  # negatives
+    m = loc_m[0].reshape(3, 4)
+    assert m[0].sum() == 4 and m[1].sum() == 0
+    # loc target encodes (gt - anchor) / variance
+    t = loc_t[0].reshape(3, 4)
+    np.testing.assert_allclose(
+        t[0], [0.0 / 0.5 / 0.1, 0.05 / 0.5 / 0.1,
+               np.log(0.4 / 0.5) / 0.2, np.log(0.5 / 0.5) / 0.2],
+        atol=1e-5)
+
+
+def test_multibox_target_no_gt():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0]]], np.float32)
+    label = np.full((1, 2, 5), -1.0, np.float32)
+    cls_pred = np.zeros((1, 3, 2), np.float32)
+    _, loc_m, cls_t = [o.asnumpy() for o in mx.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred))]
+    assert (cls_t == -1.0).all()  # everything ignored
+    assert (loc_m == 0).all()
+
+
+def test_multibox_target_negative_mining():
+    rng = np.random.RandomState(0)
+    anchors = np.zeros((1, 20, 4), np.float32)
+    # grid of anchors
+    for i in range(20):
+        x = (i % 5) * 0.2
+        y = (i // 5) * 0.25
+        anchors[0, i] = [x, y, x + 0.2, y + 0.25]
+    label = np.array([[[1, 0.0, 0.0, 0.2, 0.25],
+                       [-1, -1, -1, -1, -1]]], np.float32)
+    cls_pred = rng.randn(1, 3, 20).astype(np.float32)
+    _, _, cls_t = [o.asnumpy() for o in mx.nd.MultiBoxTarget(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred),
+        negative_mining_ratio="3", negative_mining_thresh="0.5")]
+    cls_t = cls_t[0]
+    assert (cls_t == 2.0).sum() == 1           # one positive (class 1 + 1)
+    assert (cls_t == 0.0).sum() == 3           # ratio 3 -> 3 negatives
+    assert (cls_t == -1.0).sum() == 16         # rest ignored
+
+
+# ------------------------------------------------------------- detection
+def test_multibox_detection_decode_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.11, 0.11, 0.31, 0.31],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    # class probs (B, C, A): anchor0/1 -> class 1, anchor2 -> class 2
+    cls_prob = np.array([[[0.1, 0.2, 0.1],
+                          [0.8, 0.7, 0.1],
+                          [0.1, 0.1, 0.8]]], np.float32)
+    loc_pred = np.zeros((1, 12), np.float32)  # no regression offsets
+    out = mx.nd.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred), mx.nd.array(anchors),
+        nms_threshold="0.5", threshold="0.3").asnumpy()[0]
+    # sorted by score: anchor0 (0.8 cls0), anchor2 (0.8 cls1), anchor1 nms'd
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 2
+    assert set(kept[:, 0]) == {0.0, 1.0}
+    # decoded box of anchor2 (no offsets -> anchor itself)
+    row = kept[kept[:, 0] == 1.0][0]
+    np.testing.assert_allclose(row[2:], [0.6, 0.6, 0.9, 0.9], atol=1e-5)
+
+
+def test_multibox_detection_force_suppress():
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.11, 0.11, 0.31, 0.31]]], np.float32)
+    cls_prob = np.array([[[0.1, 0.2],
+                          [0.8, 0.1],
+                          [0.1, 0.7]]], np.float32)  # different classes
+    loc_pred = np.zeros((1, 8), np.float32)
+    keep_per_class = mx.nd.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred), mx.nd.array(anchors),
+        nms_threshold="0.5").asnumpy()[0]
+    assert (keep_per_class[:, 0] >= 0).sum() == 2  # different class: kept
+    forced = mx.nd.MultiBoxDetection(
+        mx.nd.array(cls_prob), mx.nd.array(loc_pred), mx.nd.array(anchors),
+        nms_threshold="0.5", force_suppress="True").asnumpy()[0]
+    assert (forced[:, 0] >= 0).sum() == 1  # cross-class suppression
+
+
+# ------------------------------------------------------------- smooth_l1
+def test_smooth_l1():
+    x = np.array([-2.0, -0.5, 0.0, 0.3, 1.5], np.float32)
+    out = mx.nd.smooth_l1(mx.nd.array(x), scalar="1.0").asnumpy()
+    expect = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+    # sigma = 2: threshold at 1/4
+    out = mx.nd.smooth_l1(mx.nd.array(x), scalar="2.0").asnumpy()
+    expect = np.where(np.abs(x) < 0.25, 0.5 * 4 * x * x,
+                      np.abs(x) - 0.125)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+# ------------------------------------------------------------ ROIPooling
+def test_roi_pooling():
+    data = np.arange(1 * 1 * 6 * 6, dtype=np.float32).reshape(1, 1, 6, 6)
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)  # whole image
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size="(2, 2)", spatial_scale="1.0")
+    assert out.shape == (1, 1, 2, 2)
+    o = out.asnumpy()[0, 0]
+    # max of each 3x3 quadrant
+    np.testing.assert_allclose(o, [[14, 17], [32, 35]])
+
+
+def test_roi_pooling_scale_and_batch_index():
+    data = np.stack([np.zeros((1, 4, 4), np.float32),
+                     np.ones((1, 4, 4), np.float32)])
+    rois = np.array([[1, 0, 0, 7, 7]], np.float32)  # second image, scale .5
+    out = mx.nd.ROIPooling(mx.nd.array(data), mx.nd.array(rois),
+                           pooled_size="(1, 1)", spatial_scale="0.5")
+    np.testing.assert_allclose(out.asnumpy(), [[[[1.0]]]])
+
+
+def test_roi_pooling_gradient_flows():
+    data = mx.sym.Variable("data")
+    rois = mx.sym.Variable("rois")
+    pooled = mx.sym.ROIPooling(data, rois, pooled_size="(2, 2)",
+                               spatial_scale="1.0", name="roi")
+    loss = mx.sym.MakeLoss(mx.sym.sum(pooled))
+    exe = loss.simple_bind(mx.cpu(), grad_req={"data": "write",
+                                               "rois": "null"},
+                           data=(1, 1, 4, 4), rois=(1, 5))
+    exe.arg_dict["data"][:] = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    exe.arg_dict["rois"][:] = np.array([[0, 0, 0, 3, 3]], np.float32)
+    exe.forward(is_train=True)
+    exe.backward()
+    g = exe.grad_dict["data"].asnumpy()[0, 0]
+    # max elements of each 2x2 bin get gradient 1
+    assert g.sum() == 4 and g[1, 1] == 1 and g[3, 3] == 1
+
+
+# --------------------------------------------- SpatialTransformer / Grid
+def test_spatial_transformer_identity():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 5, 7).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = mx.nd.SpatialTransformer(
+        mx.nd.array(x), mx.nd.array(theta), target_shape="(5, 7)",
+        transform_type="affine", sampler_type="bilinear")
+    np.testing.assert_allclose(out.asnumpy(), x, atol=1e-5)
+
+
+def test_spatial_transformer_shift():
+    x = np.zeros((1, 1, 3, 3), np.float32)
+    x[0, 0, 1, 1] = 1.0
+    # translate by one pixel right: x' = x + 2/(W-1)
+    theta = np.array([[1, 0, -1.0, 0, 1, 0]], np.float32)
+    out = mx.nd.SpatialTransformer(
+        mx.nd.array(x), mx.nd.array(theta), target_shape="(3, 3)",
+        transform_type="affine", sampler_type="bilinear").asnumpy()
+    assert out[0, 0, 1, 2] == 1.0  # peak moved right
+
+
+def test_grid_generator_affine_plus_sampler():
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 2, 4, 4).astype(np.float32)
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    grid = mx.nd.GridGenerator(mx.nd.array(theta), transform_type="affine",
+                               target_shape="(4, 4)")
+    assert grid.shape == (1, 2, 4, 4)
+    out = mx.nd.BilinearSampler(mx.nd.array(x), grid)
+    np.testing.assert_allclose(out.asnumpy(), x, atol=1e-5)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = np.zeros((1, 2, 3, 3), np.float32)
+    grid = mx.nd.GridGenerator(mx.nd.array(flow),
+                               transform_type="warp").asnumpy()
+    np.testing.assert_allclose(grid[0, 0, 0], [-1, 0, 1], atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], [-1, 0, 1], atol=1e-6)
+
+
+# ------------------------------------------------------------ Correlation
+def test_correlation_identity():
+    rng = np.random.RandomState(2)
+    x = rng.rand(1, 4, 5, 5).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(x), mx.nd.array(x),
+                            kernel_size="1", max_displacement="1",
+                            stride1="1", stride2="1", pad_size="1",
+                            is_multiply="True")
+    # D = 3 -> 9 channels; center channel (4) = mean over C of x*x
+    assert out.shape == (1, 9, 5, 5)
+    center = out.asnumpy()[0, 4]
+    np.testing.assert_allclose(center, (x[0] ** 2).mean(axis=0), rtol=1e-5)
+
+
+def test_correlation_shifted_match():
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 2, 2] = 1.0
+    y = np.zeros((1, 1, 4, 4), np.float32)
+    y[0, 0, 2, 3] = 1.0  # shifted one to the right
+    out = mx.nd.Correlation(mx.nd.array(x), mx.nd.array(y),
+                            kernel_size="1", max_displacement="1",
+                            stride1="1", stride2="1", pad_size="1").asnumpy()
+    # channel for displacement (dy=0, dx=+1) is index 5 in the 3x3 grid
+    assert out[0, 5, 2, 2] == 1.0
+    assert out[0, 4, 2, 2] == 0.0
