@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Inference throughput sweep — parity with
+``example/image-classification/benchmark_score.py``: scores every
+network at batch sizes 1..32 on synthetic data and prints img/s.
+
+    python examples/benchmark_score.py --networks lenet,resnet-18
+"""
+
+import argparse
+import time
+
+from common.util import get_device, synthetic_image_iter  # noqa: F401  (path bootstrap)
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def score(network, batch_size, image_shape, num_classes, dev, num_batches=10):
+    sym = models.get_symbol(network, num_classes=num_classes,
+                            image_shape=image_shape)
+    data_shape = (batch_size,) + image_shape
+    # the zoo symbols end in SoftmaxOutput, so declare the label input
+    # (zero-filled at bind; unused by inference forward)
+    mod = mx.mod.Module(sym, context=dev)
+    mod.bind(data_shapes=[("data", data_shape)],
+             label_shapes=[("softmax_label", (batch_size,))],
+             for_training=False)
+    mod.init_params(mx.initializer.Xavier(magnitude=2.0))
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch([mx.nd.array(
+        rng.rand(*data_shape).astype(np.float32))], [])
+    # warmup (compile)
+    for _ in range(2):
+        mod.forward(batch, is_train=False)
+        mod.get_outputs()[0].wait_to_read()
+    tic = time.time()
+    for _ in range(num_batches):
+        mod.forward(batch, is_train=False)
+    mod.get_outputs()[0].wait_to_read()
+    return num_batches * batch_size / (time.time() - tic)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="inference benchmark")
+    parser.add_argument("--networks", type=str,
+                        default="lenet,alexnet,resnet-18,resnet-50")
+    parser.add_argument("--batch-sizes", type=str, default="1,2,4,8,16,32")
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--num-batches", type=int, default=10)
+    args = parser.parse_args()
+
+    dev = get_device()
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    for net in args.networks.split(","):
+        shape = (1, 28, 28) if net in ("lenet", "mlp") else image_shape
+        classes = 10 if net in ("lenet", "mlp") else args.num_classes
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            ips = score(net, b, shape, classes, dev, args.num_batches)
+            print(f"network: {net:16s} batch: {b:3d}  {ips:10.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()

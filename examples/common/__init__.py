@@ -1,0 +1,2 @@
+"""Shared helpers for the example scripts (reference:
+example/image-classification/common/)."""

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""PTB-style LSTM language model with bucketing — BASELINE config 3.
+
+Parity with ``example/rnn/lstm_bucketing.py`` + ``bucket_io.py``:
+variable-length sentences bucketed to a few lengths, one
+BucketingModule sharing parameters across per-bucket programs,
+Perplexity metric.  Reads a PTB-format text file (one sentence per
+line) via ``--data``; without one it generates a synthetic Markov
+corpus so the script always runs and the perplexity drop is real.
+
+    python examples/lstm_bucketing.py --num-epochs 5
+    python examples/lstm_bucketing.py --data ptb.train.txt
+"""
+
+import argparse
+import os
+
+from common.util import add_fit_args, get_device  # noqa: F401  (path bootstrap)
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+BUCKETS = [8, 16, 24, 32]
+
+
+def tokenize(path, vocab=None):
+    sentences = []
+    vocab = vocab if vocab is not None else {"<pad>": 0, "<eos>": 1}
+    with open(path) as f:
+        for line in f:
+            words = line.strip().split()
+            if not words:
+                continue
+            ids = []
+            for w in words:
+                if w not in vocab:
+                    vocab[w] = len(vocab)
+                ids.append(vocab[w])
+            ids.append(vocab["<eos>"])
+            sentences.append(ids)
+    return sentences, vocab
+
+
+def synthetic_corpus(num_sentences=600, vocab_size=64, seed=0):
+    """Markov-chain corpus: next token strongly depends on current."""
+    rng = np.random.RandomState(seed)
+    trans = rng.randint(2, vocab_size, size=(vocab_size, 2))
+    sentences = []
+    for _ in range(num_sentences):
+        n = rng.randint(5, BUCKETS[-1] + 1)
+        tok = rng.randint(2, vocab_size)
+        s = [tok]
+        for _ in range(n - 1):
+            tok = trans[tok, rng.randint(0, 2)]
+            s.append(int(tok))
+        sentences.append(s)
+    return sentences, vocab_size
+
+
+class BucketSentenceIter(mx.io.DataIter):
+    """reference: example/rnn/bucket_io.py BucketSentenceIter — pads
+    each sentence up to its bucket, batches per bucket."""
+
+    def __init__(self, sentences, batch_size, buckets=BUCKETS,
+                 data_name="data", label_name="softmax_label", seed=1):
+        super().__init__(batch_size)
+        self.data_name, self.label_name = data_name, label_name
+        self.buckets = sorted(buckets)
+        self.default_bucket_key = max(buckets)
+        self._rng = np.random.RandomState(seed)
+        per_bucket = {b: [] for b in self.buckets}
+        discarded = 0
+        for s in sentences:
+            for b in self.buckets:
+                if len(s) <= b:
+                    per_bucket[b].append(
+                        np.pad(s, (0, b - len(s)))[:b])
+                    break
+            else:
+                discarded += 1
+        if discarded:
+            print(f"discarded {discarded} sentences longer than "
+                  f"{self.default_bucket_key}")
+        skipped = {b: len(v) for b, v in per_bucket.items()
+                   if 0 < len(v) < batch_size}
+        if skipped:
+            print(f"skipping under-filled buckets (< batch_size): {skipped}")
+        self._data = {b: np.asarray(v, np.float32)
+                      for b, v in per_bucket.items() if len(v) >= batch_size}
+        if not self._data:
+            raise ValueError(
+                f"no bucket has at least batch_size={batch_size} sentences "
+                f"({len(sentences)} sentences total) — lower --batch-size")
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc(self.data_name,
+                               (self.batch_size, self.default_bucket_key))]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc(self.label_name,
+                               (self.batch_size, self.default_bucket_key))]
+
+    def reset(self):
+        self._plan = []
+        for b, arr in self._data.items():
+            idx = self._rng.permutation(len(arr))
+            for i in range(0, len(arr) - self.batch_size + 1,
+                           self.batch_size):
+                self._plan.append((b, idx[i:i + self.batch_size]))
+        self._rng.shuffle(self._plan)
+        self._cur = 0
+
+    def next(self):
+        if self._cur >= len(self._plan):
+            raise StopIteration
+        b, idx = self._plan[self._cur]
+        self._cur += 1
+        sent = self._data[b][idx]
+        data = sent
+        label = np.concatenate([sent[:, 1:], np.zeros((len(sent), 1),
+                                                      np.float32)], axis=1)
+        return mx.io.DataBatch(
+            [mx.nd.array(data)], [mx.nd.array(label)], pad=0, bucket_key=b,
+            provide_data=[mx.io.DataDesc(self.data_name,
+                                         (self.batch_size, b))],
+            provide_label=[mx.io.DataDesc(self.label_name,
+                                          (self.batch_size, b))])
+
+
+def make_sym_gen(vocab_size, num_embed, num_hidden, num_layers):
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=num_embed, name="embed")
+        rnn = mx.sym.RNN(data=mx.sym.transpose(embed, axes=(1, 0, 2)),
+                         parameters=mx.sym.Variable("rnn_parameters"),
+                         state=mx.sym.Variable("rnn_state"),
+                         state_cell=mx.sym.Variable("rnn_state_cell"),
+                         state_size=num_hidden, num_layers=num_layers,
+                         mode="lstm", name="rnn")
+        out = mx.sym.Reshape(mx.sym.transpose(rnn, axes=(1, 0, 2)),
+                             shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(out, num_hidden=vocab_size, name="pred")
+        flat_label = mx.sym.Reshape(label, shape=(-1,))
+        sm = mx.sym.SoftmaxOutput(pred, flat_label, ignore_label=0,
+                                  use_ignore=True, name="softmax")
+        return sm, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def main():
+    parser = argparse.ArgumentParser(description="LSTM bucketing LM")
+    parser.add_argument("--data", type=str, default=None,
+                        help="PTB-format text file (one sentence per line)")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=4)
+    parser.add_argument("--num-hidden", type=int, default=128)
+    parser.add_argument("--num-embed", type=int, default=64)
+    parser.add_argument("--num-layers", type=int, default=1)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--kv-store", type=str, default=None)
+    args = parser.parse_args()
+
+    if args.data and os.path.exists(args.data):
+        sentences, vocab = tokenize(args.data)
+        vocab_size = len(vocab)
+    else:
+        print("no --data file — using a synthetic Markov corpus")
+        sentences, vocab_size = synthetic_corpus()
+
+    it = BucketSentenceIter(sentences, args.batch_size)
+    dev = get_device()
+    mod = mx.mod.BucketingModule(
+        make_sym_gen(vocab_size, args.num_embed, args.num_hidden,
+                     args.num_layers),
+        default_bucket_key=it.default_bucket_key, context=dev)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mx.random.seed(0)
+    zeros = mx.nd.zeros((args.num_layers, args.batch_size, args.num_hidden))
+    mod.init_params(mx.initializer.Uniform(0.08),
+                    arg_params={"rnn_state": zeros,
+                                "rnn_state_cell": zeros.copy()})
+    mod.init_optimizer(kvstore=args.kv_store, optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    metric = mx.metric.Perplexity(ignore_label=0)
+    last_ppl = None
+    for epoch in range(args.num_epochs):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        name, ppl = metric.get()
+        print(f"Epoch[{epoch}] Train-{name}={ppl:.2f}")
+        last_ppl = ppl
+    return last_ppl
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Model-parallel LSTM — BASELINE config 5, re-expressed for TPU.
+
+The reference splits LSTM layers across GPUs with ``ctx_group`` +
+``group2ctx`` and relies on the async engine to pipeline timesteps
+(``example/model-parallel-lstm/lstm.py:48-66``).  The TPU-native
+equivalent is a device mesh: the big projection matrices are
+tensor-parallel over the 'tp' mesh axis (``annotate_shard``) and the
+batch is data-parallel over 'dp' — XLA inserts the collectives and
+overlaps them with compute, which is what the reference's pipelining
+bought.
+
+Run on one chip (degenerate 1-device mesh) or a virtual mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/model_parallel_lstm.py --tp 2
+"""
+
+import argparse
+
+from common.util import add_fit_args, get_device  # noqa: F401  (path bootstrap)
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+
+
+def lstm_lm(vocab_size, num_embed, num_hidden, num_layers, tp_shard):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                             output_dim=num_embed, name="embed")
+    rnn = mx.sym.RNN(data=mx.sym.transpose(embed, axes=(1, 0, 2)),
+                     parameters=mx.sym.Variable("rnn_parameters"),
+                     state=mx.sym.Variable("rnn_state"),
+                     state_cell=mx.sym.Variable("rnn_state_cell"),
+                     state_size=num_hidden, num_layers=num_layers,
+                     mode="lstm", name="rnn")
+    out = mx.sym.Reshape(mx.sym.transpose(rnn, axes=(1, 0, 2)),
+                         shape=(-1, num_hidden))
+    pred = mx.sym.FullyConnected(out, num_hidden=vocab_size, name="pred")
+    sm = mx.sym.SoftmaxOutput(pred, mx.sym.Reshape(label, shape=(-1,)),
+                              name="softmax")
+    if tp_shard:
+        # tensor-parallel: vocabulary projection split over 'tp'
+        # (the model-parallel axis of config 5)
+        parallel.annotate_shard(sm, "pred_weight", "tp", 0)
+        parallel.annotate_shard(sm, "embed_weight", "tp", 1)
+    return sm
+
+
+def main():
+    parser = argparse.ArgumentParser(description="model-parallel LSTM")
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--seq-len", type=int, default=16)
+    parser.add_argument("--vocab-size", type=int, default=64)
+    parser.add_argument("--num-hidden", type=int, default=128)
+    parser.add_argument("--num-embed", type=int, default=64)
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel ways (mesh axis size)")
+    parser.add_argument("--lr", type=float, default=0.01)
+    args = parser.parse_args()
+
+    import jax
+
+    n_dev = len(jax.devices())
+    if args.tp < 1:
+        parser.error(f"--tp must be >= 1, got {args.tp}")
+    tp = args.tp if n_dev % args.tp == 0 else 1
+    if tp != args.tp:
+        print(f"--tp {args.tp} does not divide {n_dev} devices; using tp=1")
+    sym = lstm_lm(args.vocab_size, args.num_embed, args.num_hidden,
+                  args.num_layers, tp_shard=tp > 1)
+
+    # synthetic next-token corpus
+    rng = np.random.RandomState(0)
+    n = 40 * args.batch_size
+    start = rng.randint(0, args.vocab_size, size=(n, 1))
+    toks = (start + np.arange(args.seq_len + 1)) % args.vocab_size
+    X = toks[:, :-1].astype(np.float32)
+    Y = toks[:, 1:].astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size, shuffle=True,
+                           last_batch_handle="discard")
+
+    dev = get_device()
+    mod = mx.mod.Module(sym, context=dev)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mx.random.seed(0)
+    zeros = mx.nd.zeros((args.num_layers, args.batch_size, args.num_hidden))
+    mod.init_params(mx.initializer.Uniform(0.08),
+                    arg_params={"rnn_state": zeros,
+                                "rnn_state_cell": zeros.copy()})
+    if tp > 1:
+        mod.set_mesh_plan(parallel.make_plan(tp=tp))
+        kv = "tpu"
+    else:
+        kv = None
+    mod.init_optimizer(kvstore=kv, optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    losses = []
+    for epoch in range(args.num_epochs):
+        it.reset()
+        losses = []
+        for b in it:
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+            out = mod.get_outputs()[0].asnumpy()
+            lab = b.label[0].asnumpy().reshape(-1).astype(int)
+            p = out[np.arange(len(lab)), lab]
+            losses.append(float(-np.log(np.maximum(p, 1e-9)).mean()))
+        print(f"Epoch[{epoch}] mesh(dp={n_dev // tp},tp={tp}) "
+              f"loss={np.mean(losses):.3f}")
+    return float(np.mean(losses)) if losses else None
+
+
+if __name__ == "__main__":
+    main()

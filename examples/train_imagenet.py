@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Train an ImageNet-class network — BASELINE config 2.
+
+Parity with ``example/image-classification/train_imagenet.py``: the
+same CLI over RecordIO data (``--data-train`` .rec packed by
+``tools/im2rec.py``) or synthetic benchmark mode (``--benchmark 1``,
+the reference's throughput-measurement path).  ``--kv-store tpu`` runs
+mesh data parallelism over every visible chip.
+
+    # throughput benchmark, synthetic data (reference --benchmark 1)
+    python examples/train_imagenet.py --network resnet-50 --benchmark 1
+
+    # real data packed with tools/im2rec.py
+    python examples/train_imagenet.py --data-train train.rec
+"""
+
+import argparse
+
+from common.util import add_fit_args, fit, synthetic_image_iter
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train imagenet",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--data-train", type=str, default=None)
+    parser.add_argument("--data-val", type=str, default=None)
+    parser.add_argument("--image-shape", type=str, default="3,224,224")
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--benchmark", type=int, default=0,
+                        help="1 = synthetic data throughput run")
+    parser.add_argument("--num-batches", type=int, default=40,
+                        help="benchmark batches per epoch")
+    parser.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
+    add_fit_args(parser)
+    parser.set_defaults(network="resnet-50", batch_size=32, num_epochs=1,
+                        lr=0.1)
+    args = parser.parse_args()
+
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    net = models.get_symbol(args.network, num_classes=args.num_classes,
+                            image_shape=image_shape)
+
+    if args.benchmark or not args.data_train:
+        train = synthetic_image_iter(args.batch_size, image_shape,
+                                     args.num_classes, args.num_batches)
+        val = None
+    else:
+        mean = [float(x) for x in args.rgb_mean.split(",")]
+        train = mx.io.ImageRecordIter(
+            path_imgrec=args.data_train, data_shape=image_shape,
+            batch_size=args.batch_size, shuffle=True, rand_crop=True,
+            rand_mirror=True, mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
+            preprocess_threads=8)
+        val = None
+        if args.data_val:
+            val = mx.io.ImageRecordIter(
+                path_imgrec=args.data_val, data_shape=image_shape,
+                batch_size=args.batch_size, mean_r=mean[0], mean_g=mean[1],
+                mean_b=mean[2], preprocess_threads=8)
+
+    fit(args, net, train, val)
+
+
+if __name__ == "__main__":
+    main()

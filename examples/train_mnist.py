@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Train LeNet/MLP on MNIST — BASELINE config 1.
+
+Parity with ``example/image-classification/train_mnist.py``: same CLI
+surface over the Module.fit path.  Uses real MNIST idx files under
+``--data-dir`` when present, otherwise a synthetic learnable digit set
+(so the script always runs end-to-end).
+
+    python examples/train_mnist.py --network lenet --num-epochs 3
+    python examples/train_mnist.py --kv-store tpu     # mesh data-parallel
+"""
+
+import argparse
+
+from common.util import add_fit_args, fit, mnist_iters
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train MNIST",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--data-dir", type=str, default="data/mnist")
+    parser.add_argument("--num-classes", type=int, default=10)
+    add_fit_args(parser)
+    parser.set_defaults(network="lenet", batch_size=64, num_epochs=3,
+                        lr=0.05)
+    args = parser.parse_args()
+
+    net = models.get_symbol(args.network, num_classes=args.num_classes)
+    train, val = mnist_iters(args, args.data_dir)
+    mod = fit(args, net, train, val,
+              epoch_size=train.num_data // args.batch_size
+              if hasattr(train, "num_data") else None)
+    score = mod.score(val, "acc")
+    print(f"final validation accuracy: {score[0][1]:.4f}")
+    return score[0][1]
+
+
+if __name__ == "__main__":
+    main()

@@ -402,6 +402,14 @@ class Module(BaseModule):
             self._preload_opt_states = None
 
     # ------------------------------------------------------------------
+    def set_mesh_plan(self, plan):
+        """Pin this module's arrays to a device-mesh layout (public hook
+        for tensor/data-parallel placement built with
+        ``parallel.make_plan``/``MeshPlan``).  Call after bind()."""
+        assert self.binded, "call bind before set_mesh_plan"
+        self._mesh_plan = plan
+        self._apply_mesh_plan()
+
     def borrow_optimizer(self, shared_module):
         """Share one optimizer across modules — the BucketingModule
         mechanism (reference: module.py borrow_optimizer)."""
