@@ -102,10 +102,10 @@ def main():
     mod.init_optimizer(kvstore=kv, optimizer="adam",
                        optimizer_params={"learning_rate": args.lr})
 
-    losses = []
+    losses = []  # last epoch's per-batch losses (empty if 0 epochs)
     for epoch in range(args.num_epochs):
         it.reset()
-        losses = []
+        losses = []  # noqa: it intentionally holds only the last epoch
         for b in it:
             mod.forward(b, is_train=True)
             mod.backward()

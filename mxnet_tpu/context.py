@@ -81,7 +81,9 @@ class Context:
         if self._jax_device is not None:
             return self._jax_device
         if self.device_type in ("cpu", "cpu_pinned"):
-            devs = jax.devices("cpu")
+            # this process's devices: in a multi-process runtime the
+            # global list contains peers' unaddressable devices
+            devs = _local_cpu_devices()
             self._jax_device = devs[self.device_id % len(devs)]
         else:
             devs = _accelerator_devices()
@@ -89,8 +91,16 @@ class Context:
         return self._jax_device
 
 
-def _accelerator_devices():
-    devs = jax.devices()
+def _local_cpu_devices():
+    try:
+        return jax.local_devices(backend="cpu")
+    except RuntimeError:  # no cpu backend registered (rare)
+        devs = [d for d in jax.local_devices() if d.platform == "cpu"]
+        return devs or jax.devices("cpu")
+
+
+def _accelerator_devices(local_only: bool = True):
+    devs = jax.local_devices() if local_only else jax.devices()
     accel = [d for d in devs if d.platform != "cpu"]
     return accel if accel else devs
 
@@ -109,8 +119,9 @@ def tpu(device_id: int = 0) -> Context:
 
 
 def num_devices(device_type: str = "tpu") -> int:
+    """Per-process (addressable) device count."""
     if device_type in ("cpu", "cpu_pinned"):
-        return len(jax.devices("cpu"))
+        return len(_local_cpu_devices())
     return len(_accelerator_devices())
 
 

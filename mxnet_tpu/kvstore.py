@@ -176,13 +176,32 @@ class DistKVStore(TPUKVStore):
         # wire the distributed runtime BEFORE any jax call that would
         # initialize the XLA backend (jax.distributed.initialize must
         # run first in the process); only attempted when the launcher
-        # configured the coordinator env
-        if "JAX_COORDINATOR_ADDRESS" in os.environ or \
+        # (tools/launch.py) or the cluster env configured a coordinator
+        if kv_type == "dist_async" or kv_type == "dist_device_async":
+            logging.warning(
+                "kvstore %r: async consistency is not supported on this "
+                "backend (no parameter-server process); running with "
+                "bulk-synchronous semantics — every worker must push "
+                "each key the same number of times.", kv_type)
+        coord = os.environ.get("MXNET_COORDINATOR")
+        kwargs = {}
+        if coord:
+            for var in ("MXNET_NUM_WORKERS", "MXNET_WORKER_ID"):
+                if var not in os.environ:
+                    raise MXNetError(
+                        f"MXNET_COORDINATOR is set but {var} is missing — "
+                        "use tools/launch.py or export the full launcher "
+                        "environment")
+            kwargs = dict(
+                coordinator_address=coord,
+                num_processes=int(os.environ["MXNET_NUM_WORKERS"]),
+                process_id=int(os.environ["MXNET_WORKER_ID"]))
+        if coord or "JAX_COORDINATOR_ADDRESS" in os.environ or \
                 "COORDINATOR_ADDRESS" in os.environ:
             import jax
 
             try:
-                jax.distributed.initialize()
+                jax.distributed.initialize(**kwargs)
             except RuntimeError as exc:
                 if "already" in str(exc).lower():
                     pass  # launcher/driver initialized it — fine
@@ -191,8 +210,10 @@ class DistKVStore(TPUKVStore):
                     # single-process would train on 1/N of the data while
                     # looking healthy (the reference's ps-lite connects or
                     # dies, kvstore_dist.h:33-38) — so die too
-                    nproc = int(os.environ.get("JAX_NUM_PROCESSES",
-                                os.environ.get("NUM_PROCESSES", "1")))
+                    nproc = int(kwargs.get(
+                        "num_processes",
+                        os.environ.get("JAX_NUM_PROCESSES",
+                                       os.environ.get("NUM_PROCESSES", "1"))))
                     if nproc > 1:
                         raise MXNetError(
                             f"kvstore {kv_type!r}: jax.distributed.initialize "
@@ -203,6 +224,74 @@ class DistKVStore(TPUKVStore):
                         "kvstore %r: jax.distributed.initialize failed (%s); "
                         "single configured process — proceeding locally.",
                         kv_type, exc)
+        self._start_heartbeat()
+
+    # -- cross-process aggregation -------------------------------------
+    def push(self, key, value, priority=0):
+        """Local reduce, then bulk-synchronous cross-worker sum.
+
+        Matches the reference sync semantics: the server applies the
+        update once the sum of every worker's push has arrived
+        (kvstore_dist_server.h:164-198).  Here every worker computes the
+        identical global sum (allgather over DCN + on-device add), so
+        the replicated updater produces identical weights everywhere —
+        no parameter-server process needed.
+
+        Every worker must push the same keys the same number of times
+        (bulk-synchronous); a worker erroring out of the collective is
+        surfaced to its peers by the JAX coordinator failing their
+        collectives when the process exits.
+        """
+        import jax
+
+        if jax.process_count() == 1:
+            return super().push(key, value, priority)
+        from jax.experimental import multihost_utils
+
+        keys, values = _key_value_lists(key, value)
+        for k, vlist in zip(keys, values):
+            if k not in self._store:
+                raise MXNetError(f"push to uninitialized key {k}")
+            merged = vlist[0]._data if len(vlist) == 1 else _tree_sum(
+                tuple(v._data for v in vlist))
+            gathered = multihost_utils.process_allgather(merged)
+            merged = jnp.sum(gathered, axis=0)
+            stored = self._store[k]
+            if self._updater is not None:
+                self._updater(k, NDArray(merged), stored)
+            else:
+                stored._set_data(merged.astype(stored.dtype))
+
+    # -- heartbeat-based failure detection -----------------------------
+    def _start_heartbeat(self):
+        """File-heartbeat liveness (the ps-lite heartbeat role,
+        kvstore_dist.h:151-160): each worker touches
+        ``$MXNET_KVSTORE_HEARTBEAT_DIR/hb_<rank>`` every interval; peers
+        whose file goes stale count as dead."""
+        import os
+        import threading
+        import time
+
+        self._hb_dir = os.environ.get("MXNET_KVSTORE_HEARTBEAT_DIR")
+        self._hb_interval = float(os.environ.get(
+            "MXNET_KVSTORE_HEARTBEAT_INTERVAL", "1.0"))
+        if not self._hb_dir:
+            return
+        os.makedirs(self._hb_dir, exist_ok=True)
+        path = os.path.join(self._hb_dir, f"hb_{self.rank}")
+
+        def beat():
+            while True:
+                try:
+                    with open(path, "w") as f:
+                        f.write(str(time.time()))
+                except OSError:
+                    pass
+                time.sleep(self._hb_interval)
+
+        t = threading.Thread(target=beat, daemon=True,
+                             name="mxnet_tpu-kvstore-heartbeat")
+        t.start()
 
     def barrier(self):
         """All-process rendezvous (reference: kvstore_dist.h Barrier →
@@ -214,11 +303,30 @@ class DistKVStore(TPUKVStore):
 
             multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")
 
-    def get_num_dead_node(self, node_id=0, timeout=0):
-        """JAX's coordinator fails collectives on peer loss rather than
-        heartbeating a count; report 0 while the runtime is healthy."""
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Count workers whose heartbeat file is stale (reference:
+        kvstore.h:242 / ps-lite heartbeats, kvstore_dist.h:151-160).
+
+        ``timeout`` is the staleness threshold in seconds.  Without a
+        heartbeat dir (no launcher), fall back to runtime health: JAX's
+        coordinator fails collectives on peer loss, so report 0 while
+        the runtime answers."""
+        import os
+        import time
+
         import jax
 
+        if self._hb_dir:
+            now = time.time()
+            dead = 0
+            for r in range(self.num_workers):
+                path = os.path.join(self._hb_dir, f"hb_{r}")
+                try:
+                    if now - os.path.getmtime(path) > timeout:
+                        dead += 1
+                except OSError:
+                    dead += 1  # never wrote a heartbeat
+            return dead
         try:
             jax.process_count()
             return 0

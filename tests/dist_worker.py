@@ -1,0 +1,64 @@
+"""Worker script for the multi-process dist_sync proof.
+
+Launched by ``tools/launch.py -n N --cpu python tests/dist_worker.py``
+(model: ``/root/reference/tests/nightly/dist_sync_kvstore.py`` — numeric
+check that N workers' pushes sum, incl. a big array and the
+server-side-updater path)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+SHAPE = (3, 4)
+BIG_SHAPE = (120, 120)  # the reference uses a >BIGARRAY_BOUND tensor
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    want = int(os.environ["MXNET_NUM_WORKERS"])
+    assert nw == want, f"runtime has {nw} processes, launcher started {want}"
+
+    # --- plain sum semantics (no updater) ----------------------------
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(99, mx.nd.zeros(BIG_SHAPE))
+    expected = sum(r + 1 for r in range(nw))
+    for _ in range(3):
+        kv.push(3, mx.nd.ones(SHAPE) * (rank + 1))
+        out = mx.nd.zeros(SHAPE)
+        kv.pull(3, out=out)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.full(SHAPE, float(expected)))
+
+    # multi-array push: local reduce then cross-worker sum
+    kv.push(99, [mx.nd.ones(BIG_SHAPE), mx.nd.ones(BIG_SHAPE)])
+    out = mx.nd.zeros(BIG_SHAPE)
+    kv.pull(99, out=out)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full(BIG_SHAPE, 2.0 * nw))
+
+    kv.barrier()
+
+    # --- updater path: identical replicated update everywhere --------
+    kv.init("w", mx.nd.zeros(SHAPE))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5, rescale_grad=1.0,
+                                      wd=0.0))
+    kv.push("w", mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full(SHAPE, -0.5 * nw), rtol=1e-5)
+
+    # --- liveness ----------------------------------------------------
+    assert kv.get_num_dead_node(timeout=30) == 0
+    kv.barrier()
+    print(f"worker {rank}/{nw}: dist_sync kvstore OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Multi-process dist_sync tests: tools/launch.py spawns 2 real
+processes sharing one JAX distributed runtime (the reference tests
+multi-node the same way: ``tools/launch.py -n 3 --launcher local``,
+``tests/nightly/dist_sync_kvstore.py``)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_launch_two_process_dist_sync():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--cpu",
+         sys.executable, os.path.join(REPO, "tests", "dist_worker.py")],
+        capture_output=True, text=True, timeout=600,
+        cwd=REPO)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out
+    assert "worker 0/2: dist_sync kvstore OK" in out
+    assert "worker 1/2: dist_sync kvstore OK" in out
+
+
+def test_heartbeat_dead_node_detection(tmp_path, monkeypatch):
+    """A stale heartbeat file counts as a dead worker."""
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_DIR", str(hb))
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.2")
+    kv = mx.kv.create("dist_sync")  # single-process: no coordinator env
+
+    class TwoWorkerView(type(kv)):
+        @property
+        def num_workers(self):
+            return 2
+
+    kv.__class__ = TwoWorkerView
+    time.sleep(0.5)  # our own heartbeat fires
+    # rank 0 (us) alive, rank 1 never wrote -> 1 dead
+    assert kv.get_num_dead_node(timeout=5) == 1
+    # a fresh rank-1 heartbeat brings it back
+    (hb / "hb_1").write_text(str(time.time()))
+    assert kv.get_num_dead_node(timeout=5) == 0
+    # stale rank-1 heartbeat dies again
+    old = time.time() - 100
+    os.utime(hb / "hb_1", (old, old))
+    assert kv.get_num_dead_node(timeout=5) == 1
+
+
+def test_launcher_propagates_failure():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--cpu", sys.executable, "-c", "import sys; sys.exit(3)"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert r.returncode == 1
+    assert "failed" in r.stderr
