@@ -122,6 +122,12 @@ class Executor:
             self.grad_dict.get(n) for n in self.arg_names]
 
         self._grad_names = [n for n in self.arg_names if self.grad_req.get(n, "null") != "null"]
+        # gradient mirroring / rematerialization: trade FLOPs for memory
+        # by recomputing activations in backward (reference:
+        # MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:199-212 → here a
+        # jax.checkpoint over the whole forward)
+        from .base import get_env
+        self._do_mirror = bool(get_env("MXNET_BACKWARD_DO_MIRROR", 0, int))
         self._monitor_callback = None
         self._graph_fn = build_graph_fn(symbol)
         self._jit_fwd = jax.jit(functools.partial(self._fwd, is_train=False))
@@ -164,6 +170,8 @@ class Executor:
             outs, new_aux = self._graph_fn(full, aux_vals, rng, True)
             return tuple(outs), new_aux
 
+        if self._do_mirror:
+            f = jax.checkpoint(f)
         grad_args = {n: arg_vals[n] for n in grad_names}
         (outs, vjp_fn, new_aux) = jax.vjp(f, grad_args, has_aux=True)
         grads = vjp_fn(tuple(heads))[0]
@@ -181,6 +189,8 @@ class Executor:
             outs, new_aux = self._graph_fn(full, aux_vals, rng, True)
             return tuple(outs), new_aux
 
+        if self._do_mirror:
+            f = jax.checkpoint(f)
         grad_args = {n: arg_vals[n] for n in grad_names}
         (outs, vjp_fn, new_aux) = jax.vjp(f, grad_args, has_aux=True)
         heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)

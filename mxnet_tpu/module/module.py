@@ -251,6 +251,15 @@ class Module(BaseModule):
         shape_kwargs = dict(self._data_shapes)
         if self._label_shapes:
             shape_kwargs.update(dict(self._label_shapes))
+        # dtype flows from the data descriptors into the bound program
+        # (fp16/bf16 training binds fp16 params — reference test_dtype.py);
+        # infer_type propagates it into every homogeneous parameter
+        type_dict = {}
+        for descs in (data_shapes, label_shapes or []):
+            for d in descs:
+                dt = getattr(d, "dtype", None)
+                if dt is not None:
+                    type_dict[d[0]] = np.dtype(dt)
 
         req = {}
         for name in self._symbol.list_arguments():
@@ -265,7 +274,8 @@ class Module(BaseModule):
 
         shared_exec = shared_module._exec if shared_module is not None else None
         self._exec = self._symbol.simple_bind(
-            self._context[0], grad_req=req, shared_exec=shared_exec, **shape_kwargs)
+            self._context[0], grad_req=req, type_dict=type_dict or None,
+            shared_exec=shared_exec, **shape_kwargs)
         _, out_shapes, _ = self._symbol.infer_shape(**shape_kwargs)
         self._inferred_output_shapes = list(zip(self._output_names, out_shapes))
         self.binded = True
@@ -535,6 +545,8 @@ class Module(BaseModule):
         lr_mult = {n: optimizer.lr_mult.get(n, 1.0) for n in pnames}
         wd_mult = {n: optimizer.wd_mult.get(n, 1.0) for n in pnames}
 
+        do_mirror = self._exec._do_mirror
+
         def step(params, fixed, aux, states, inputs, key, lr, t):
             # per-step PRNG derived on device from the base key + int32
             # step counter — no per-step host→device key transfer
@@ -547,6 +559,11 @@ class Module(BaseModule):
                 outs, new_aux = graph_fn(full, aux, rng, True)
                 return tuple(outs), new_aux
 
+            if do_mirror:
+                # MXNET_BACKWARD_DO_MIRROR: recompute activations in
+                # backward instead of storing them (memory ↓, FLOPs ↑)
+                f = jax.checkpoint(f)
+
             outs, vjp_fn, new_aux = jax.vjp(f, params, has_aux=True)
             heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads = vjp_fn(heads)[0]
@@ -557,8 +574,10 @@ class Module(BaseModule):
                 w, s = optimizer.apply(params[n], grads[n], states[n],
                                        lr * lr_mult[n],
                                        optimizer.wd * wd_mult[n], t_f)
-                new_params[n] = w
-                new_states[n] = s
+                # the f32 lr scalar must not promote low-precision params
+                new_params[n] = w.astype(params[n].dtype)
+                new_states[n] = jax.tree_util.tree_map(
+                    lambda new, old: new.astype(old.dtype), s, states[n])
             return list(outs), new_params, new_aux, new_states, t + 1
 
         return jax.jit(step, donate_argnums=(0, 3, 7))
@@ -655,8 +674,9 @@ class Module(BaseModule):
                 w, s = optimizer.apply(params[n], grads[n], states[n],
                                        lr * lr_mult[n],
                                        optimizer.wd * wd_mult[n], t_f)
-                new_params[n] = w
-                new_states[n] = s
+                new_params[n] = w.astype(params[n].dtype)
+                new_states[n] = jax.tree_util.tree_map(
+                    lambda new, old: new.astype(old.dtype), s, states[n])
             return new_params, new_states, t + 1
 
         return jax.jit(apply_grads, donate_argnums=(0, 2, 4))

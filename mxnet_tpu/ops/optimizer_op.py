@@ -1,0 +1,81 @@
+"""Optimizer-as-op: ``sgd_update`` / ``sgd_mom_update`` / ``adam_update``.
+
+Parity with ``src/operator/optimizer_op.cc:14-39`` (NNVM FCompute
+optimizer kernels used to run updates on-device imperatively).  The
+reference mutates weight/state in place; here the ops are functional —
+they return the updated arrays (assign back with ``out=`` or the
+returned values).  The Module fast path fuses updates into the training
+program instead (module.py _build_fused_step); these registered ops
+serve custom training loops and the kvstore updater path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import attr_float
+from .registry import register
+
+
+def _prep_grad(grad, attrs):
+    rescale = attr_float(attrs.get("rescale_grad", 1.0), 1.0)
+    clip = attr_float(attrs.get("clip_gradient", -1.0), -1.0)
+    g = grad * rescale
+    if clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+def _same_as_inputs(n_out):
+    def infer(attrs, in_shapes):
+        known = next((s for s in in_shapes if s is not None), None)
+        return in_shapes, [known] * n_out, []
+    return infer
+
+
+@register("sgd_update", arg_names=("weight", "grad"),
+          infer_shape=_same_as_inputs(1),
+          doc="w' = w - lr * (rescale*clip(grad) + wd*w).  reference: "
+              "src/operator/optimizer_op.cc sgd_update")
+def _sgd_update(op_ctx, attrs, inputs, aux):
+    w, grad = inputs
+    lr = attr_float(attrs.get("lr"), 0.01)
+    wd = attr_float(attrs.get("wd", 0.0), 0.0)
+    g = _prep_grad(grad, attrs) + wd * w
+    return [w - lr * g]
+
+
+@register("sgd_mom_update", arg_names=("weight", "grad", "mom"),
+          out_names=("weight", "mom"),
+          infer_shape=_same_as_inputs(2),
+          doc="momentum SGD step; returns (weight', mom').  reference: "
+              "src/operator/optimizer_op.cc sgd_mom_update")
+def _sgd_mom_update(op_ctx, attrs, inputs, aux):
+    w, grad, mom = inputs
+    lr = attr_float(attrs.get("lr"), 0.01)
+    wd = attr_float(attrs.get("wd", 0.0), 0.0)
+    momentum = attr_float(attrs.get("momentum", 0.0), 0.0)
+    g = _prep_grad(grad, attrs) + wd * w
+    new_mom = momentum * mom - lr * g
+    return [w + new_mom, new_mom]
+
+
+@register("adam_update", arg_names=("weight", "grad", "mean", "var"),
+          out_names=("weight", "mean", "var"),
+          infer_shape=_same_as_inputs(3),
+          doc="Adam step; returns (weight', mean', var').  reference: "
+              "src/operator/optimizer_op.cc adam_update")
+def _adam_update(op_ctx, attrs, inputs, aux):
+    w, grad, mean, var = inputs
+    lr = attr_float(attrs.get("lr"), 0.001)
+    beta1 = attr_float(attrs.get("beta1", 0.9), 0.9)
+    beta2 = attr_float(attrs.get("beta2", 0.999), 0.999)
+    eps = attr_float(attrs.get("epsilon", 1e-8), 1e-8)
+    wd = attr_float(attrs.get("wd", 0.0), 0.0)
+    # reference AdamUpdate (optimizer_op-inl.h:160-176): moments from the
+    # wd-free gradient, decay applied directly to the weight
+    g = _prep_grad(grad, attrs)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * g * g
+    new_w = (1.0 - lr * wd) * w - lr * new_mean / (jnp.sqrt(new_var) + eps)
+    return [new_w, new_mean, new_var]
