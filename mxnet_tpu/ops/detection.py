@@ -298,8 +298,9 @@ def _multibox_detection(op_ctx, attrs, inputs, aux):
         rows = jnp.concatenate([cid[:, None], score[:, None], boxes], axis=1)
         # sort by score descending (invalid rows sink)
         order = jnp.argsort(-score)
-        rows = rows[order]
+        return rows[order]
 
+    def nms_fallback(r):
         # greedy NMS over sorted rows (reference nested loop as fori)
         def nms_round(i, r):
             alive_i = r[i, 0] >= 0
@@ -310,9 +311,14 @@ def _multibox_detection(op_ctx, attrs, inputs, aux):
                 & (iou >= nms_threshold)
             return r.at[:, 0].set(jnp.where(suppress, -1.0, r[:, 0]))
 
-        if 0 < nms_threshold <= 1:
-            rows = lax.fori_loop(0, A, nms_round, rows)
-        return rows
+        return lax.fori_loop(0, A, nms_round, r)
 
     out = jax.vmap(one_batch)(cls_prob, loc_pred)
+    if 0 < nms_threshold <= 1:
+        from . import pallas_kernels as _pk
+
+        if _pk.enabled() and out.dtype == jnp.float32:
+            out = _pk.nms(out, nms_threshold, force_suppress)
+        else:
+            out = jax.vmap(nms_fallback)(out)
     return [lax.stop_gradient(out)]

@@ -1,0 +1,211 @@
+"""Hand-written Pallas TPU kernels for the hot fused ops.
+
+This is the framework's user-kernel layer — the TPU equivalent of the
+reference's runtime CUDA compilation (``src/common/mxrtc.cc:13-76``,
+``python/mxnet/rtc.py``) applied to the two ops SURVEY §7 calls out:
+
+* ``lstm_scan``: the LSTM recurrence as ONE kernel over a sequential
+  ``grid=(T,)`` with the hidden/cell state resident in VMEM scratch —
+  state never round-trips to HBM between timesteps, the per-step
+  ``h @ U`` runs on the MXU, and the gate math fuses on the VPU.
+  Differentiable via custom_vjp: backward rematerializes through the
+  jax.lax.scan formulation (activations are never stored — remat).
+* ``nms``: greedy class-aware non-max suppression over score-sorted
+  rows as one kernel — the sequential suppression loop runs on-chip
+  over VMEM-resident boxes (MultiBoxDetection is stop_gradient, so no
+  VJP is needed).
+
+Kernels run natively on TPU; everywhere else they run in interpreter
+mode, which keeps CPU tests meaningful (same kernel code path).
+Opt-out / force: ``MXNET_PALLAS=0|1`` (default: on for TPU backends).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu import works on non-TPU hosts; kernels then use interpret
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def enabled() -> bool:
+    """Use the Pallas kernels?  Default: only on a real TPU backend."""
+    if pltpu is None:
+        return False  # kernels need the TPU pallas module (scratch/VMEM)
+    flag = os.environ.get("MXNET_PALLAS")
+    if flag is not None:
+        return flag != "0"
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _vmem_spec(block=None, index_map=None):
+    kwargs = {}
+    if pltpu is not None:
+        kwargs["memory_space"] = pltpu.VMEM
+    if block is None:
+        return pl.BlockSpec(**kwargs)
+    return pl.BlockSpec(block, index_map, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# LSTM scan
+# ---------------------------------------------------------------------------
+
+def _lstm_kernel(xw_ref, h0_ref, c0_ref, ut_ref, y_ref, ht_ref, ct_ref,
+                 h_scr, c_scr):
+    """One timestep per grid iteration; h/c live in VMEM scratch.
+
+    TPU grids execute sequentially, which is exactly the dependency
+    order of the recurrence."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    hidden = h_scr.shape[-1]
+    pre = xw_ref[0] + jnp.dot(h_scr[:], ut_ref[:],
+                              preferred_element_type=jnp.float32)
+    i = jax.nn.sigmoid(pre[:, 0 * hidden:1 * hidden])
+    f = jax.nn.sigmoid(pre[:, 1 * hidden:2 * hidden])
+    g = jnp.tanh(pre[:, 2 * hidden:3 * hidden])
+    o = jax.nn.sigmoid(pre[:, 3 * hidden:4 * hidden])
+    c = f * c_scr[:] + i * g
+    h = o * jnp.tanh(c)
+    h_scr[:] = h
+    c_scr[:] = c
+    y_ref[0] = h
+    ht_ref[:] = h  # last grid step's write is the final state
+    ct_ref[:] = c
+
+
+def _lstm_pallas_fwd(xw, h0, c0, ut):
+    """xw: (T, B, 4H) input projection (+biases); ut: (H, 4H)."""
+    T, B, G = xw.shape
+    H = G // 4
+    dt = xw.dtype
+    y, hT, cT = pl.pallas_call(
+        _lstm_kernel,
+        grid=(T,),
+        in_specs=[
+            _vmem_spec((1, B, G), lambda t: (t, 0, 0)),
+            _vmem_spec((B, H), lambda t: (0, 0)),
+            _vmem_spec((B, H), lambda t: (0, 0)),
+            _vmem_spec((H, G), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, B, H), lambda t: (t, 0, 0)),
+            _vmem_spec((B, H), lambda t: (0, 0)),
+            _vmem_spec((B, H), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+        ],
+        scratch_shapes=([pltpu.VMEM((B, H), jnp.float32),
+                         pltpu.VMEM((B, H), jnp.float32)]
+                        if pltpu is not None else []),
+        interpret=_interpret(),
+    )(xw, h0, c0, ut)
+    return y, hT, cT
+
+
+def _lstm_reference(xw, h0, c0, ut):
+    """The differentiable formulation the VJP remats through — the SAME
+    cell step ops/rnn.py scans with, so kernel forward and remat
+    backward cannot drift apart."""
+    from .rnn import _cell_step
+
+    cell = _cell_step("lstm", h0.shape[-1])
+
+    def step(carry, x_t):
+        return cell(carry, x_t + carry[0] @ ut)
+
+    (hT, cT), y = jax.lax.scan(step, (h0, c0), xw)
+    return y, hT, cT
+
+
+@jax.custom_vjp
+def lstm_scan(xw, h0, c0, ut):
+    """Pallas LSTM recurrence: (T,B,4H), (B,H), (B,H), (H,4H) →
+    (y (T,B,H), hT, cT)."""
+    return _lstm_pallas_fwd(xw, h0, c0, ut)
+
+
+def _lstm_fwd_rule(xw, h0, c0, ut):
+    outs = _lstm_pallas_fwd(xw, h0, c0, ut)
+    return outs, (xw, h0, c0, ut)
+
+
+def _lstm_bwd_rule(res, cots):
+    # rematerialize: forward activations were never stored (VMEM-only),
+    # so backward re-runs the scan formulation under jax.vjp
+    _, vjp = jax.vjp(_lstm_reference, *res)
+    return vjp(cots)
+
+
+lstm_scan.defvjp(_lstm_fwd_rule, _lstm_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# Greedy NMS
+# ---------------------------------------------------------------------------
+
+def _nms_kernel(rows_ref, out_ref, *, nms_threshold, force_suppress):
+    """rows (1, A, 6) score-sorted [cls, score, l, t, r, b]; suppressed
+    rows get cls = -1.  The i-loop is sequential (each round depends on
+    previous suppressions); each round's IoU test is one VPU vector op
+    over all rows."""
+    out_ref[:] = rows_ref[:]
+    A = out_ref.shape[1]
+
+    def round_i(i, _):
+        cls_i = out_ref[0, i, 0]
+        box_i = out_ref[0, i, 2:6]
+        cls = out_ref[0, :, 0]
+        l = jnp.maximum(out_ref[0, :, 2], box_i[0])
+        t = jnp.maximum(out_ref[0, :, 3], box_i[1])
+        r = jnp.minimum(out_ref[0, :, 4], box_i[2])
+        b = jnp.minimum(out_ref[0, :, 5], box_i[3])
+        inter = jnp.maximum(r - l, 0.0) * jnp.maximum(b - t, 0.0)
+        area = (out_ref[0, :, 4] - out_ref[0, :, 2]) * \
+               (out_ref[0, :, 5] - out_ref[0, :, 3])
+        area_i = (box_i[2] - box_i[0]) * (box_i[3] - box_i[1])
+        union = area + area_i - inter
+        iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-12), 0.0)
+        later = jax.lax.broadcasted_iota(jnp.int32, (A,), 0) > i
+        same = jnp.logical_or(bool(force_suppress), cls == cls_i)
+        suppress = (cls_i >= 0) & later & same & (cls >= 0) \
+            & (iou >= nms_threshold)
+        out_ref[0, :, 0] = jnp.where(suppress, -1.0, cls)
+        return 0
+
+    jax.lax.fori_loop(0, A, round_i, 0)
+
+
+def nms(rows, nms_threshold, force_suppress):
+    """rows (B, A, 6) sorted by score desc → suppressed rows cls=-1."""
+    B, A, _ = rows.shape
+    kern = functools.partial(_nms_kernel, nms_threshold=float(nms_threshold),
+                             force_suppress=bool(force_suppress))
+    return pl.pallas_call(
+        kern,
+        grid=(B,),
+        in_specs=[_vmem_spec((1, A, 6), lambda b: (b, 0, 0))],
+        out_specs=_vmem_spec((1, A, 6), lambda b: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, A, 6), rows.dtype),
+        interpret=_interpret(),
+    )(rows)

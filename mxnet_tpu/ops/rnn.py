@@ -127,6 +127,19 @@ def _scan_direction(x, h0, c0, w, u, bw, bu, mode, reverse):
 
     # whole-sequence input projection on the MXU
     xw = jnp.einsum("tbi,gi->tbg", x, w) + bw + bu
+
+    if mode == "lstm" and xw.dtype == jnp.float32:
+        from . import pallas_kernels as _pk
+
+        if _pk.enabled():
+            # hand-written Pallas recurrence: h/c stay in VMEM across
+            # the whole sequence (see pallas_kernels.lstm_scan)
+            xw_d = jnp.flip(xw, 0) if reverse else xw
+            y, hT, cT = _pk.lstm_scan(xw_d, h0, c0, u.T)
+            if reverse:
+                y = jnp.flip(y, 0)
+            return y, hT, cT
+
     cell = _cell_step(mode, h)
 
     def scan_fn(carry, x_t):

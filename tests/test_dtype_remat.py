@@ -189,3 +189,16 @@ def test_adam_update_op_with_wd():
     np.testing.assert_allclose(
         new_w.asnumpy(),
         (1 - 0.01 * 0.01) * w - 0.01 * em / (np.sqrt(ev) + 1e-8), rtol=1e-5)
+
+
+def test_infer_type_multi_branch():
+    """A known output dtype flows back into untyped branches."""
+    a = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fca")
+    b = mx.sym.FullyConnected(mx.sym.Variable("side"), num_hidden=4,
+                              name="fcb")
+    out = a + b
+    at, _, _ = out.infer_type(data="float16")
+    named = dict(zip(out.list_arguments(), at))
+    assert str(named["fcb_weight"]) == "float16", named
+    assert str(named["side"]) == "float16", named
