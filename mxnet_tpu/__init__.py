@@ -43,6 +43,7 @@ from . import model
 from . import module
 from . import module as mod
 from . import operator
+from . import sequence
 from . import monitor
 from .monitor import Monitor
 from . import profiler

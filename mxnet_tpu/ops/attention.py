@@ -1,0 +1,128 @@
+"""Attention ops.
+
+The reference predates attention (SURVEY §5.7), but the framework's
+long-context story needs it as a first-class op: this registers a
+fused multi-head scaled-dot-product attention usable from symbols and
+imperatively, with a blockwise (FlashAttention-style) formulation that
+never materializes the full (T, T) score matrix — the building block
+``mxnet_tpu.sequence`` distributes over the mesh (ring / Ulysses).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError, attr_bool, attr_int
+from .registry import register
+
+
+def blockwise_attention_partial(q, k, v, causal=False, block_size=512,
+                                kv_offset=0):
+    """Online-softmax attention over K/V blocks — UN-normalized state.
+
+    q: (B, Tq, H, D); k, v: (B, Tk, H, D) → (o (B,H,Tq,D), m, l) with
+    ``out = o / l`` after all partial states are merged.
+    ``kv_offset`` is the absolute position of k[0] minus the absolute
+    position of q[0] (the ring rotation uses it for causal masking
+    across shards).  Memory: O(Tq · block) instead of O(Tq·Tk).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
+    block = min(block_size, Tk)
+    nblocks = (Tk + block - 1) // block
+    pad = nblocks * block - Tk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = k.reshape(B, nblocks, block, H, D)
+    vb = v.reshape(B, nblocks, block, H, D)
+    q_pos = jnp.arange(Tq)
+
+    def body(carry, blk):
+        o, m, l = carry
+        k_j, v_j, j = blk
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_j) * scale
+        k_pos = j * block + jnp.arange(block) + kv_offset
+        valid = (j * block + jnp.arange(block)) < Tk  # padding mask
+        mask = valid[None, None, None, :]
+        if causal:
+            mask = mask & (k_pos[None, None, None, :]
+                           <= q_pos[None, None, :, None])
+        s = jnp.where(mask, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # guard fully-masked rows (m_new = -inf): exp(-inf - -inf)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_j)
+        return (o_new, m_new, l_new), None
+
+    o0, m0, l0 = attention_state_init(q)
+    (o, m, l), _ = lax.scan(
+        body, (o0, m0, l0),
+        (kb.swapaxes(0, 1), vb.swapaxes(0, 1), jnp.arange(nblocks)))
+    return o, m, l
+
+
+def normalize_attention_state(o, m, l, dtype):
+    """(o, m, l) partial state → (B, Tq, H, D) attention output."""
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.swapaxes(1, 2).astype(dtype)
+
+
+def blockwise_attention(q, k, v, causal=False, block_size=512):
+    """Normalized blockwise attention: (B, T, H, D) → (B, T, H, D)."""
+    o, m, l = blockwise_attention_partial(q, k, v, causal=causal,
+                                          block_size=block_size)
+    return normalize_attention_state(o, m, l, q.dtype)
+
+
+def attention_state_init(q):
+    """Empty online-softmax state for q (B, Tq, H, D) → (o, m, l).
+
+    Derived from q rather than fresh constants so that under shard_map
+    the carries have the same varying-axis type as the loop body's
+    outputs (fresh constants are 'unvarying' and fail the scan check).
+    """
+    o0 = q.swapaxes(1, 2).astype(jnp.float32) * 0.0  # (B, H, Tq, D)
+    l0 = o0[..., 0]
+    m0 = l0 - jnp.inf
+    return o0, m0, l0
+
+
+def attention_state_merge(o, m, l, o2, m2, l2):
+    """Combine two partial online-softmax states (ring accumulation)."""
+    m_new = jnp.maximum(m, m2)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    a1 = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    a2 = jnp.where(jnp.isfinite(m2), jnp.exp(m2 - m_safe), 0.0)
+    return (o * a1[..., None] + o2 * a2[..., None],
+            m_new, l * a1 + l2 * a2)
+
+
+def _attention_infer(attrs, in_shapes):
+    q, k, v = in_shapes
+    if q is None:
+        return in_shapes, None, None
+    return in_shapes, [tuple(q)], []
+
+
+@register("DotProductAttention", arg_names=("query", "key", "value"),
+          infer_shape=_attention_infer,
+          aliases=("MultiHeadAttention",),
+          doc="Fused blockwise multi-head attention: (B, T, H, D) "
+              "q/k/v -> (B, T, H, D); attrs: causal, block_size")
+def _attention(op_ctx, attrs, inputs, aux):
+    q, k, v = inputs
+    if q.ndim != 4:
+        raise MXNetError("DotProductAttention expects (B, T, H, D) inputs")
+    causal = attr_bool(attrs.get("causal", False), False)
+    block = attr_int(attrs.get("block_size", 512), 512)
+    return [blockwise_attention(q, k, v, causal=causal, block_size=block)]

@@ -93,6 +93,11 @@ def _lstm_kernel(xw_ref, h0_ref, c0_ref, ut_ref, y_ref, ht_ref, ct_ref,
 
 def _lstm_pallas_fwd(xw, h0, c0, ut):
     """xw: (T, B, 4H) input projection (+biases); ut: (H, 4H)."""
+    if pltpu is None:
+        raise RuntimeError(
+            "Pallas TPU module unavailable (jax.experimental.pallas.tpu "
+            "failed to import) — the lstm_scan kernel needs its VMEM "
+            "scratch allocators; use the lax.scan path instead")
     T, B, G = xw.shape
     H = G // 4
     dt = xw.dtype
@@ -115,9 +120,8 @@ def _lstm_pallas_fwd(xw, h0, c0, ut):
             jax.ShapeDtypeStruct((B, H), dt),
             jax.ShapeDtypeStruct((B, H), dt),
         ],
-        scratch_shapes=([pltpu.VMEM((B, H), jnp.float32),
-                         pltpu.VMEM((B, H), jnp.float32)]
-                        if pltpu is not None else []),
+        scratch_shapes=[pltpu.VMEM((B, H), jnp.float32),
+                        pltpu.VMEM((B, H), jnp.float32)],
         interpret=_interpret(),
     )(xw, h0, c0, ut)
     return y, hT, cT

@@ -1,0 +1,140 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference's long-sequence story is bucketing + recompute (SURVEY
+§5.7); this module supplies the scale dimension the reference never
+had: shard the SEQUENCE axis over a mesh axis so context length grows
+linearly with chips.
+
+* ``ring_attention``: each shard keeps its Q block resident and
+  rotates K/V blocks around the ring with ``lax.ppermute`` (ICI
+  neighbor exchanges), merging per-hop online-softmax partial states —
+  compute overlaps the rotation, full (T, T) scores never exist, and
+  per-chip memory is O(T/sp).
+* ``ulysses_attention``: ``lax.all_to_all`` re-shards sequence ↔ heads
+  so each chip runs full-sequence attention for H/sp heads, then
+  a2a's back.  Cheaper collectives when heads ≥ sp; ring wins when a
+  single head's full sequence no longer fits.
+
+Both run inside ``shard_map`` over a ``Mesh`` built by
+``sequence_mesh`` and are validated against single-device blockwise
+attention on the virtual CPU mesh (tests/test_sequence.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .base import MXNetError
+from .ops.attention import (attention_state_init, attention_state_merge,
+                            blockwise_attention_partial,
+                            normalize_attention_state)
+
+__all__ = ["sequence_mesh", "ring_attention", "ulysses_attention"]
+
+
+def sequence_mesh(sp: Optional[int] = None, devices=None,
+                  axis_name: str = "sp") -> Mesh:
+    """A 1-D mesh over the sequence-parallel axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    sp = sp or len(devices)
+    if sp > len(devices):
+        raise MXNetError(f"sp={sp} exceeds {len(devices)} devices")
+    return Mesh(np.asarray(devices[:sp]), (axis_name,))
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, block_size):
+    """shard_map body: q/k/v are the local (B, T/sp, H, D) shards."""
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    perm = [(i, (i + 1) % sp) for i in range(sp)]  # ring: send right
+
+    def partial_for(k_cur, v_cur, src):
+        kv_off = (src - idx) * t_local  # k_abs_start - q_abs_start
+        return blockwise_attention_partial(
+            q, k_cur, v_cur, causal=causal, block_size=block_size,
+            kv_offset=kv_off)
+
+    def merge_hop(state, k_cur, v_cur, src):
+        o, m, l = state
+        o2, m2, l2 = partial_for(k_cur, v_cur, src)
+        return attention_state_merge(o, m, l, o2, m2, l2)
+
+    def hop(carry, j):
+        o, m, l, k_cur, v_cur = carry
+        # rotate first: K/V for this hop come from shard (idx - j) mod sp
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        src = (idx - j) % sp
+        if causal:
+            # a strictly-future shard contributes nothing under the
+            # causal mask — skip its whole attention compute
+            o, m, l = lax.cond(
+                src > idx,
+                lambda s, kc, vc, sr: s,
+                lambda s, kc, vc, sr: merge_hop(s, kc, vc, sr),
+                (o, m, l), k_cur, v_cur, src)
+        else:
+            o, m, l = merge_hop((o, m, l), k_cur, v_cur, src)
+        return (o, m, l, k_cur, v_cur), None
+
+    # hop 0 (the local shard) needs no rotation; hops 1..sp-1 rotate
+    # then compute, so no collective's result is ever discarded
+    state = merge_hop(attention_state_init(q), k, v, idx)
+    (o, m, l, _, _), _ = lax.scan(hop, (*state, k, v),
+                                  jnp.arange(1, sp))
+    return normalize_attention_state(o, m, l, q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = False, block_size: int = 512):
+    """Sequence-parallel attention: (B, T, H, D) global arrays with T
+    sharded over ``axis_name``; returns same-sharded output."""
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal, block_size=block_size),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, axis_name, causal, block_size):
+    """a2a: (B, T/sp, H, D) → (B, T, H/sp, D), attend, a2a back."""
+    sp = lax.psum(1, axis_name)
+    H = q.shape[2]
+    if H % sp != 0:
+        raise MXNetError(f"ulysses needs heads ({H}) divisible by sp ({sp})")
+
+    def seq_to_heads(x):
+        # split heads across the axis, gather the full sequence
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    o, m, l = blockwise_attention_partial(qf, kf, vf, causal=causal,
+                                          block_size=block_size)
+    out = normalize_attention_state(o, m, l, q.dtype)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                      causal: bool = False, block_size: int = 512):
+    """All-to-all sequence parallelism (Ulysses): T sharded in/out,
+    heads sharded during the attention itself."""
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name,
+                          causal=causal, block_size=block_size),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
