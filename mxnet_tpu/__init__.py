@@ -15,6 +15,7 @@ __version__ = "0.1.0"
 
 from . import base
 from .base import MXNetError
+from . import config
 from . import context
 from .context import Context, cpu, gpu, tpu, current_context
 from . import ndarray
@@ -43,6 +44,8 @@ from . import model
 from . import module
 from . import module as mod
 from . import operator
+from . import predictor
+from .predictor import Predictor
 from . import sequence
 from . import monitor
 from .monitor import Monitor

@@ -1,0 +1,99 @@
+"""Runtime configuration catalog.
+
+The reference documents its ~20 ``MXNET_*`` env vars in
+``docs/how_to/env_var.md`` read via ``dmlc::GetEnv`` (SURVEY §5.6).
+This module is the equivalent declarative catalog: every environment
+variable the framework reads, with type, default, and documentation —
+queryable at runtime (``mx.config.list_env()``, ``describe()``) so
+configuration is discoverable rather than folklore.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+from typing import Any, Dict, List
+
+from .base import get_env
+
+__all__ = ["EnvVar", "register_env", "list_env", "describe", "current"]
+
+EnvVar = namedtuple("EnvVar", ["name", "default", "dtype", "doc"])
+
+_CATALOG: Dict[str, EnvVar] = {}
+
+
+def register_env(name: str, default, dtype: type, doc: str) -> None:
+    """Declare an environment variable the framework reads."""
+    _CATALOG[name] = EnvVar(name, default, dtype, doc)
+
+
+def list_env() -> List[EnvVar]:
+    """All declared env vars, sorted by name."""
+    return [_CATALOG[k] for k in sorted(_CATALOG)]
+
+
+def describe(name: str) -> EnvVar:
+    if name not in _CATALOG:
+        raise KeyError(f"{name!r} is not a declared mxnet_tpu env var; "
+                       f"known: {sorted(_CATALOG)}")
+    return _CATALOG[name]
+
+
+def current() -> Dict[str, Any]:
+    """Effective value of every declared var (env override or default)."""
+    return {v.name: get_env(v.name, v.default, v.dtype)
+            for v in list_env()}
+
+
+# ---------------------------------------------------------------------------
+# The catalog (reference: docs/how_to/env_var.md)
+# ---------------------------------------------------------------------------
+
+register_env(
+    "MXNET_FUSED_STEP", 1, int,
+    "1 (default): Module training runs as ONE donated XLA program "
+    "(forward+backward+optimizer).  0: separate forward/backward/update "
+    "programs (debugging; matches the reference's per-phase execution).")
+register_env(
+    "MXNET_BACKWARD_DO_MIRROR", 0, int,
+    "1: recompute activations in backward (jax.checkpoint over the "
+    "forward) instead of storing them — memory down, ~30% more FLOPs.  "
+    "The reference's gradient-mirroring flag "
+    "(graph_executor.cc:199-212).")
+register_env(
+    "MXNET_PALLAS", None, str,
+    "Force the hand-written Pallas kernels on ('1') or off ('0').  "
+    "Unset (default): kernels run on TPU backends, lax fallbacks "
+    "elsewhere.  Forcing on off-TPU uses the (slow) interpreter — "
+    "useful for testing the kernel code path.")
+register_env(
+    "MXNET_PROFILER_AUTOSTART", 0, int,
+    "1: start the Chrome-trace profiler at import "
+    "(reference: env_var.md MXNET_PROFILER_AUTOSTART).")
+register_env(
+    "MXNET_COORDINATOR", None, str,
+    "host:port of the JAX coordination service for multi-process "
+    "(dist_*) runs.  Set by tools/launch.py; requires "
+    "MXNET_NUM_WORKERS and MXNET_WORKER_ID.")
+register_env(
+    "MXNET_NUM_WORKERS", 1, int,
+    "Total process count of a dist_* run (launcher-set).")
+register_env(
+    "MXNET_WORKER_ID", 0, int,
+    "This process's rank in a dist_* run (launcher-set).")
+register_env(
+    "MXNET_KVSTORE_HEARTBEAT_DIR", None, str,
+    "Shared directory for worker heartbeat files (liveness /  "
+    "get_num_dead_node).  Set by tools/launch.py.")
+register_env(
+    "MXNET_KVSTORE_HEARTBEAT_INTERVAL", 1.0, float,
+    "Seconds between heartbeat file touches.")
+register_env(
+    "MXNET_TEST_DEVICE", "cpu", str,
+    "Device the test utilities bind to (test_utils.default_context; "
+    "the reference's MXNET_TEST_DEVICE).")
+register_env(
+    "MXNET_TEST_TPU", 0, int,
+    "1: run the pytest suite against the real TPU instead of the "
+    "virtual CPU mesh (tests/conftest.py).")

@@ -328,18 +328,47 @@ class Module(BaseModule):
     def _apply_mesh_plan(self):
         """Pin every executor array to its mesh placement: inputs batch-
         sharded over 'dp', params/aux replicated unless a '__shard__'
-        symbol attr requests tensor-parallel sharding."""
+        symbol attr — or the param's ctx_group via the plan's group2ctx
+        mapping (model-parallel layer groups) — requests sharding."""
         plan = self._mesh_plan
         attrs = self._symbol.attr_dict()
         input_names = set(self._data_names) | set(self._label_names)
+        # ctx_group resolution: a param uses its own group attr, else
+        # the group of an op consuming it (AttrScope puts the attr on
+        # the ops created inside the scope)
+        groups = {}
+        if plan.group2ctx:
+            for n in self._symbol._topo():
+                g = n._meta.get("ctx_group", n.attrs.get("ctx_group"))
+                if not g:
+                    continue
+                if n.is_variable:
+                    groups[n.name] = g
+                else:
+                    for (i, _ix) in n.inputs:
+                        if i.is_variable:
+                            groups.setdefault(i.name, g)
         for name, shapes in (self._data_shapes or []):
             plan.check_batch(shapes[plan.batch_axis] if shapes else 0)
         for name, arr in self._exec.arg_dict.items():
             if name in input_names:
                 sh = plan.input_sharding(arr.ndim)
             else:
-                sh = plan.param_sharding(arr.ndim,
-                                         attrs.get(name, {}).get("__shard__"))
+                shard = attrs.get(name, {}).get("__shard__")
+                if shard is None and name in groups:
+                    shard = plan.group2ctx.get(groups[name])
+                    if shard is not None:
+                        parts = str(shard).split(":")
+                        if len(parts) != 2 \
+                                or not parts[1].lstrip("-").isdigit():
+                            raise MXNetError(
+                                f"bad group2ctx placement {shard!r} for "
+                                f"group {groups[name]!r}; want 'axis:dim'")
+                        # group placement is best-effort per param: a
+                        # bias can't shard on the matrix dim — replicate
+                        if int(parts[1]) >= arr.ndim:
+                            shard = None
+                sh = plan.param_sharding(arr.ndim, shard)
             arr._sharding = sh
             arr._set_data(arr._data)  # re-place via the sharding pin
             g = self._exec.grad_dict.get(name)

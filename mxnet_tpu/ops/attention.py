@@ -10,8 +10,6 @@ never materializes the full (T, T) score matrix — the building block
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax

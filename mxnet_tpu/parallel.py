@@ -56,7 +56,7 @@ class MeshPlan:
     """A device mesh + the sharding rules for one Module's program."""
 
     def __init__(self, devices: Sequence, dp: Optional[int] = None, tp: int = 1,
-                 batch_axis: int = 0):
+                 batch_axis: int = 0, group2ctx: Optional[Dict] = None):
         import jax
         from jax.sharding import Mesh
 
@@ -72,6 +72,13 @@ class MeshPlan:
         self.batch_axis = batch_axis
         self.devices = list(devices)
         self.mesh = Mesh(np.asarray(self.devices).reshape(dp, tp), ("dp", "tp"))
+        # ctx_group → placement: the reference's model-parallel layer
+        # groups (AttrScope(ctx_group=g) + bind(group2ctx={g: ctx}),
+        # graph_executor.cc:301) reinterpreted mesh-natively — each
+        # group maps to an "axis:dim" sharding for its parameters
+        # instead of a whole device, and XLA inserts the cross-shard
+        # transfers the PlaceDevice pass inserted as _CrossDeviceCopy
+        self.group2ctx: Dict[str, str] = dict(group2ctx or {})
 
     @property
     def num_devices(self) -> int:
@@ -130,7 +137,7 @@ class MeshPlan:
 
 
 def make_plan(contexts: Optional[Sequence[Context]] = None, tp: int = 1,
-              batch_axis: int = 0) -> MeshPlan:
+              batch_axis: int = 0, group2ctx: Optional[Dict] = None) -> MeshPlan:
     """Build a MeshPlan from Module contexts (or every visible device).
 
     With a context list, each context resolves to its jax device (the
@@ -146,4 +153,5 @@ def make_plan(contexts: Optional[Sequence[Context]] = None, tp: int = 1,
             raise MXNetError("duplicate devices in context list")
     else:
         devices = jax.devices()
-    return MeshPlan(devices, tp=tp, batch_axis=batch_axis)
+    return MeshPlan(devices, tp=tp, batch_axis=batch_axis,
+                    group2ctx=group2ctx)

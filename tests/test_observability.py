@@ -141,3 +141,27 @@ def test_monitor_fires_during_training():
     mod.update()
     res = mon.toc()
     assert any("output" in k for _, k, _ in res), res
+
+
+def test_env_var_catalog():
+    """Every env var the code reads is declared in the config catalog."""
+    import re
+
+    cat = {v.name for v in mx.config.list_env()}
+    # scan the source for MXNET_* reads
+    used = set()
+    pkg = os.path.dirname(mx.__file__)
+    for root, _, files in os.walk(pkg):
+        for f in files:
+            if not f.endswith(".py") or f == "config.py":
+                continue
+            src = open(os.path.join(root, f)).read()
+            used.update(re.findall(r"MXNET_[A-Z_]+", src))
+    used.discard("MXNET_")  # the prefix mention in base.py docs
+    missing = used - cat
+    assert not missing, f"undeclared env vars: {sorted(missing)}"
+    # catalog answers queries
+    v = mx.config.describe("MXNET_BACKWARD_DO_MIRROR")
+    assert v.default == 0 and "recompute" in v.doc
+    cur = mx.config.current()
+    assert "MXNET_FUSED_STEP" in cur

@@ -153,3 +153,46 @@ def test_batch_not_divisible_raises():
     with pytest.raises(mx.base.MXNetError):
         mod.bind(data_shapes=it_shapes, label_shapes=[("softmax_label", (10,))],
                  for_training=True)
+
+
+def test_ctx_group_group2ctx_mesh_mapping():
+    """AttrScope(ctx_group=...) + group2ctx places a layer group's
+    params on a mesh axis (the reference model-parallel idiom,
+    reinterpreted; graph_executor.cc:301)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="body"):
+        net = mx.sym.Activation(
+            mx.sym.FullyConnected(data, num_hidden=32, name="fc1"),
+            act_type="relu")
+    with mx.AttrScope(ctx_group="head"):
+        net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(mx.initializer.Xavier())
+    from mxnet_tpu import parallel
+    mod.set_mesh_plan(parallel.make_plan(
+        tp=2, group2ctx={"body": "tp:0", "head": "tp:1"}))
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    # fc1 weight sharded over tp on dim 0, fc2 on dim 1
+    from jax.sharding import PartitionSpec as P
+    assert mod._exec.arg_dict["fc1_weight"]._data.sharding.spec == P("tp", None)
+    assert mod._exec.arg_dict["fc2_weight"]._data.sharding.spec == P(None, "tp")
+    # and it trains
+    b = next(iter(it))
+    mod.forward_backward(b)
+    mod.update()
+    out = mod.get_outputs()[0].asnumpy()
+    assert np.isfinite(out).all()
