@@ -51,10 +51,10 @@ def current() -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 register_env(
-    "MXNET_FUSED_STEP", 1, int,
-    "1 (default): Module training runs as ONE donated XLA program "
-    "(forward+backward+optimizer).  0: separate forward/backward/update "
-    "programs (debugging; matches the reference's per-phase execution).")
+    "MXNET_FUSED_STEP", "1", str,
+    "'1' (default): Module training runs as ONE donated XLA program "
+    "(forward+backward+optimizer).  '0': separate forward/backward/"
+    "update programs (debugging; the reference's per-phase execution).")
 register_env(
     "MXNET_BACKWARD_DO_MIRROR", 0, int,
     "1: recompute activations in backward (jax.checkpoint over the "
@@ -90,9 +90,10 @@ register_env(
     "MXNET_KVSTORE_HEARTBEAT_INTERVAL", 1.0, float,
     "Seconds between heartbeat file touches.")
 register_env(
-    "MXNET_TEST_DEVICE", "cpu", str,
+    "MXNET_TEST_DEVICE", None, str,
     "Device the test utilities bind to (test_utils.default_context; "
-    "the reference's MXNET_TEST_DEVICE).")
+    "the reference's MXNET_TEST_DEVICE).  Unset: the ambient current "
+    "context.")
 register_env(
     "MXNET_TEST_TPU", 0, int,
     "1: run the pytest suite against the real TPU instead of the "

@@ -359,11 +359,11 @@ class Module(BaseModule):
                     shard = plan.group2ctx.get(groups[name])
                     if shard is not None:
                         parts = str(shard).split(":")
-                        if len(parts) != 2 \
-                                or not parts[1].lstrip("-").isdigit():
+                        if len(parts) != 2 or not parts[1].isdigit():
                             raise MXNetError(
                                 f"bad group2ctx placement {shard!r} for "
-                                f"group {groups[name]!r}; want 'axis:dim'")
+                                f"group {groups[name]!r}; want "
+                                "'axis:dim' with a non-negative dim")
                         # group placement is best-effort per param: a
                         # bias can't shard on the matrix dim — replicate
                         if int(parts[1]) >= arr.ndim:

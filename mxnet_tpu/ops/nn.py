@@ -55,11 +55,13 @@ def _fully_connected(op_ctx, attrs, inputs, aux):
     data, weight = inputs[0], inputs[1]
     if flatten and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
+    # no explicit preferred_element_type: the MXU accumulates bf16
+    # operands in f32 in hardware, and an explicit f32 preference makes
+    # the conv/dot vjp mix dtypes (f32 cotangent vs bf16 operands)
     out = lax.dot_general(
         data, weight,
         dimension_numbers=(((data.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if not no_bias:
         out = out + inputs[2]
     return [out]
@@ -217,8 +219,7 @@ def _convolution(op_ctx, attrs, inputs, aux):
         rhs_dilation=dilate,
         dimension_numbers=_CONV_DIMNUMS[nd],
         feature_group_count=groups,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if not attr_bool(attrs.get("no_bias"), False):
         bias = inputs[2].reshape((1, -1) + (1,) * nd)
         out = out + bias
@@ -277,8 +278,7 @@ def _deconvolution(op_ctx, attrs, inputs, aux):
         rhs_dilation=dilate,
         dimension_numbers=_CONV_DIMNUMS[nd],
         feature_group_count=groups,
-        preferred_element_type=jnp.float32,
-    ).astype(data.dtype)
+    )
     if not attr_bool(attrs.get("no_bias"), True):
         out = out + inputs[2].reshape((1, -1) + (1,) * nd)
     return [out]

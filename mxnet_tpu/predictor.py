@@ -66,9 +66,11 @@ class Predictor:
 
         self._ctx = ctx or current_context()
         dev = self._ctx.jax_device()
-        self._input_names = [n for n in arg_names
-                             if n in input_shapes
-                             or not any(key[1] == n for key in params)]
+        # inputs are exactly the names the caller bound shapes for (the
+        # reference's explicit input_keys); everything else must come
+        # from params — a truncated checkpoint errors as 'missing
+        # parameter', not as a phantom input
+        self._input_names = [n for n in arg_names if n in input_shapes]
         input_dtypes = input_dtypes or {}
 
         shape_kwargs = {n: tuple(s) for n, s in input_shapes.items()}
