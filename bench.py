@@ -194,7 +194,7 @@ def main():
     # varies second-to-second, so time several windows and report the
     # best sustained one (the achievable device throughput); every
     # window's steps still train the same program (canary below).
-    windows = int(os.environ.get("BENCH_WINDOWS", "8"))
+    windows = min(int(os.environ.get("BENCH_WINDOWS", "8")), max(iters, 1))
     per_window = max(iters // windows, 1)
     window_ms = []
     steps_done = 0

@@ -38,7 +38,7 @@ _UNARY = {
     "rint": jnp.rint,
     "ceil": jnp.ceil,
     "floor": jnp.floor,
-    "fix": jnp.fix,
+    "fix": jnp.trunc,  # fix == round toward zero (jnp.fix is deprecated)
     "trunc": jnp.trunc,
     "square": jnp.square,
     "sqrt": jnp.sqrt,

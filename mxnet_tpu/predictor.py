@@ -80,10 +80,9 @@ class Predictor:
             raise MXNetError("cannot infer shapes from the given inputs")
 
         def get(kind, name, shape):
+            # every non-input argument / aux state must come from params
             v = params.get((kind, name))
             if v is None:
-                if name in self._input_names:
-                    return None
                 raise MXNetError(f"missing parameter {name!r}")
             arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
             if tuple(arr.shape) != tuple(shape):

@@ -1,0 +1,84 @@
+"""Attribute-scope + RNG tests (reference:
+tests/python/unittest/test_attr.py + test_random.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+# ----------------------------------------------------------------- attrs
+def test_attr_basic():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data, name="conv", kernel=(1, 1), num_filter=1,
+                            attr={"__mood__": "so so"})
+    assert data.attr("mood") == "angry"
+    assert op.attr("__mood__") == "so so"
+
+
+def test_attr_scope_propagation():
+    with mx.AttrScope(__group__="4", __data__="great"):
+        data = mx.sym.Variable("data", attr={"specific": "code"})
+        gdata = mx.sym.Variable("data2")
+    assert gdata.attr("__group__") == "4"
+    assert data.attr("__group__") == "4"
+    assert data.attr("specific") == "code"
+    assert data.attr("__data__") == "great"
+
+
+def test_attr_scope_nesting():
+    with mx.AttrScope(x="1"):
+        with mx.AttrScope(y="2"):
+            v = mx.sym.Variable("v")
+        w = mx.sym.Variable("w")
+    assert v.attr("x") == "1" and v.attr("y") == "2"
+    assert w.attr("x") == "1" and w.attr("y") is None
+
+
+def test_attr_survives_json_roundtrip():
+    with mx.AttrScope(ctx_group="stage1"):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    loaded = mx.sym.load_json(net.tojson())
+    d = loaded.attr_dict()
+    assert d["fc"].get("ctx_group") == "stage1"
+
+
+# ------------------------------------------------------------------- rng
+def test_random_seed_determinism():
+    mx.random.seed(128)
+    a = mx.nd.uniform(0, 1, shape=(100,)).asnumpy()
+    mx.random.seed(128)
+    b = mx.nd.uniform(0, 1, shape=(100,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = mx.nd.uniform(0, 1, shape=(100,)).asnumpy()
+    assert not np.array_equal(b, c)  # stream advances
+
+
+def test_uniform_moments():
+    mx.random.seed(0)
+    x = mx.nd.uniform(-2.0, 6.0, shape=(50000,)).asnumpy()
+    assert x.min() >= -2.0 and x.max() < 6.0
+    assert abs(x.mean() - 2.0) < 0.1
+    assert abs(x.std() - 8.0 / np.sqrt(12)) < 0.1
+
+
+def test_normal_moments():
+    mx.random.seed(1)
+    x = mx.nd.normal(3.0, 2.0, shape=(50000,)).asnumpy()
+    assert abs(x.mean() - 3.0) < 0.1
+    assert abs(x.std() - 2.0) < 0.1
+
+
+def test_dropout_uses_fresh_masks():
+    """Two training forwards draw different dropout masks (the
+    ResourceManager kRandom role: per-invocation PRNG)."""
+    sym = mx.sym.Dropout(mx.sym.Variable("data"), p=0.5, name="drop")
+    exe = sym.simple_bind(mx.cpu(), grad_req="null", data=(64, 64))
+    exe.arg_dict["data"][:] = np.ones((64, 64), np.float32)
+    a = exe.forward(is_train=True)[0].asnumpy()
+    b = exe.forward(is_train=True)[0].asnumpy()
+    assert not np.array_equal(a, b)
+    # inference: identity
+    c = exe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(c, 1.0)

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Parse a training log into a markdown table — parity with the
+reference's ``tools/parse_log.py`` (same Epoch[N] Train/Validation/Time
+line format that ``Module.fit`` logs).
+
+    python tools/parse_log.py train.log
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+
+
+def parse(lines):
+    res = [re.compile(r".*Epoch\[(\d+)\] Train.*=([.\d]+)"),
+           re.compile(r".*Epoch\[(\d+)\] Valid.*=([.\d]+)"),
+           re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")]
+    data = {}
+    for line in lines:
+        for i, r in enumerate(res):
+            m = r.match(line)
+            if m is not None:
+                epoch = int(m.group(1))
+                val = float(m.group(2))
+                row = data.setdefault(epoch, [[0.0, 0] for _ in res])
+                row[i][0] += val
+                row[i][1] += 1
+                break
+    return data
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("logfile", type=str)
+    parser.add_argument("--format", choices=["markdown", "none"],
+                        default="markdown")
+    args = parser.parse_args()
+    with open(args.logfile) as f:
+        data = parse(f.readlines())
+    if args.format == "markdown":
+        print("| epoch | train-accuracy | valid-accuracy | time |")
+        print("| --- | --- | --- | --- |")
+    for epoch in sorted(data):
+        row = data[epoch]
+        vals = [(s / n if n else float("nan")) for s, n in row]
+        if args.format == "markdown":
+            print(f"| {epoch} | {vals[0]:f} | {vals[1]:f} | {vals[2]:.1f} |")
+        else:
+            print(epoch, *[f"{v:f}" for v in vals])
+
+
+if __name__ == "__main__":
+    main()
