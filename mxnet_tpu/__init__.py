@@ -16,6 +16,7 @@ __version__ = "0.1.0"
 from . import base
 from .base import MXNetError
 from . import config
+from . import engine
 from . import context
 from .context import Context, cpu, gpu, tpu, current_context
 from . import ndarray

@@ -68,6 +68,12 @@ register_env(
     "elsewhere.  Forcing on off-TPU uses the (slow) interpreter — "
     "useful for testing the kernel code path.")
 register_env(
+    "MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice", str,
+    "Scheduling mode (reference: src/engine/engine.cc:13-39).  "
+    "'NaiveEngine': synchronous debugging — every op blocks to "
+    "completion so failures surface at the faulting call.  The two "
+    "threaded names mean normal async XLA dispatch.")
+register_env(
     "MXNET_PROFILER_AUTOSTART", 0, int,
     "1: start the Chrome-trace profiler at import "
     "(reference: env_var.md MXNET_PROFILER_AUTOSTART).")

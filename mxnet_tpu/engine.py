@@ -1,0 +1,110 @@
+"""Engine control — the host-side face of the execution scheduler.
+
+The reference's dependency engine (``include/mxnet/engine.h``,
+``src/engine/``) topologically schedules every NDArray mutation across
+worker threads and CUDA streams.  On this build XLA's async dispatch
+*is* the engine: ops enqueue device work and return immediately,
+`wait_to_read`/`waitall` are the blocking points, and data dependencies
+are buffer dependencies tracked by the runtime.
+
+What remains host-side — and lives here — is the reference's engine
+*control* surface:
+
+* ``set_engine_type('NaiveEngine'|'ThreadedEngine'|
+  'ThreadedEnginePerDevice')`` / ``MXNET_ENGINE_TYPE`` — NaiveEngine
+  reproduces the reference's debugging mode (``src/engine/engine.cc:
+  20-30``): every imperative op and executor run blocks to completion
+  before returning, so failures surface at the faulting call with a
+  clean stack instead of at a later sync point (the exact procedure
+  the reference prescribes for engine debugging, threaded_engine.h:
+  336-344).
+* ``push(fn, read_arrays, write_arrays)`` — run a host closure after
+  its data dependencies are ready (Engine::PushSync role for host
+  callbacks such as checkpoint writers).
+* ``wait_for_var(arr)`` / ``wait_all()`` — WaitForVar / WaitForAll.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from .base import MXNetError, get_env
+
+__all__ = ["set_engine_type", "engine_type", "is_naive", "push",
+           "wait_for_var", "wait_all"]
+
+_VALID = ("NaiveEngine", "ThreadedEngine", "ThreadedEnginePerDevice")
+DEFAULT_ENGINE_TYPE = "ThreadedEnginePerDevice"
+
+# process-global like the reference's engine singleton (a PrefetchingIter
+# worker thread must honor a NaiveEngine switch made in the main thread);
+# resolved once at import, dmlc::GetEnv-once style
+_engine_type = get_env("MXNET_ENGINE_TYPE", DEFAULT_ENGINE_TYPE, str)
+if _engine_type not in _VALID:
+    raise MXNetError(
+        f"MXNET_ENGINE_TYPE={_engine_type!r} is not one of {_VALID}")
+_naive = _engine_type == "NaiveEngine"
+
+
+def engine_type() -> str:
+    return _engine_type
+
+
+def set_engine_type(name: str) -> None:
+    """Switch scheduling mode (reference: MXNET_ENGINE_TYPE).
+
+    'NaiveEngine' = synchronous debugging mode; the two threaded names
+    both mean normal async XLA dispatch (the distinction the reference
+    draws between its pooled/per-device thread policies is owned by
+    the XLA runtime here)."""
+    global _engine_type, _naive
+    if name not in _VALID:
+        raise MXNetError(f"unknown engine type {name!r}; one of {_VALID}")
+    _engine_type = name
+    _naive = name == "NaiveEngine"
+
+
+def is_naive() -> bool:
+    return _naive
+
+
+def sync_if_naive(arrays) -> None:
+    """Block on freshly produced arrays under NaiveEngine (called by
+    the imperative invoke + executor dispatch points).  The fast path
+    is a single global-bool check."""
+    if not _naive:
+        return
+    import jax
+
+    jax.block_until_ready([a._data if hasattr(a, "_data") else a
+                           for a in arrays])
+
+
+def wait_for_var(arr) -> None:
+    """Engine::WaitForVar — block until the array's value is final."""
+    arr.wait_to_read()
+
+
+def wait_all() -> None:
+    """Engine::WaitForAll."""
+    from . import ndarray as nd
+
+    nd.waitall()
+
+
+def push(fn: Callable[[], None], read_arrays: Sequence = (),
+         write_arrays: Sequence = ()) -> None:
+    """Run a host closure once its dependencies are ready
+    (Engine::PushSync for host work: logging, checkpoint writers).
+
+    Both reads and writes block until any pending device work on them
+    completes (the reference's mutate-var ordering: the closure may
+    not run before earlier writers finish); the closure then runs
+    inline — for device work XLA's own dependency tracking provides
+    the async engine semantics.
+    """
+    for a in read_arrays:
+        wait_for_var(a)
+    for a in write_arrays:
+        wait_for_var(a)
+    fn()

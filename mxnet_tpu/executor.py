@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import engine as _engine
 from . import profiler as _prof
 from .base import MXNetError
 from .context import Context
@@ -256,6 +257,7 @@ class Executor:
         for name, val in new_aux.items():
             self.aux_dict[name]._set_data(val)
         self.outputs_cache = [NDArray(o, self._ctx) for o in outs]
+        _engine.sync_if_naive(self.outputs_cache)
         return self.outputs_cache
 
     def backward(self, out_grads=None):
@@ -301,6 +303,7 @@ class Executor:
                 dst._set_data(dst._data + g.astype(dst.dtype))
             else:
                 dst._set_data(g.astype(dst.dtype))
+        _engine.sync_if_naive([self.grad_dict[n] for n in self._grad_names])
 
     def forward_backward(self, **kwargs):
         """Fused one-program training step (TPU fast path)."""

@@ -2,6 +2,7 @@
 tests/python/unittest/test_attr.py + test_random.py)."""
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 
@@ -82,3 +83,44 @@ def test_dropout_uses_fresh_masks():
     # inference: identity
     c = exe.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(c, 1.0)
+
+
+# ---------------------------------------------------------------- engine
+def test_engine_types_same_results():
+    """All engine modes compute identical results over a random
+    dependency workload (reference: tests/cpp/threaded_engine_test.cc)."""
+    rng = np.random.RandomState(0)
+    a0 = rng.randn(16, 16).astype(np.float32)
+
+    def workload():
+        x = mx.nd.array(a0)
+        for i in range(10):
+            y = mx.nd.dot(x, x) * 0.01
+            x = x + y - mx.nd.mean(y)
+        return x.asnumpy()
+
+    baseline = workload()
+    for et in ("NaiveEngine", "ThreadedEngine", "ThreadedEnginePerDevice"):
+        mx.engine.set_engine_type(et)
+        try:
+            np.testing.assert_allclose(workload(), baseline, rtol=1e-6)
+        finally:
+            mx.engine.set_engine_type("ThreadedEnginePerDevice")
+
+
+def test_engine_naive_blocks_and_push():
+    mx.engine.set_engine_type("NaiveEngine")
+    try:
+        assert mx.engine.is_naive()
+        x = mx.nd.uniform(0, 1, shape=(8, 8))
+        y = mx.nd.dot(x, x)  # completes synchronously under NaiveEngine
+        ran = []
+        mx.engine.push(lambda: ran.append(True), read_arrays=[y])
+        assert ran == [True]
+        mx.engine.wait_for_var(y)
+        mx.engine.wait_all()
+    finally:
+        mx.engine.set_engine_type("ThreadedEnginePerDevice")
+    with pytest.raises(mx.MXNetError):
+        mx.engine.set_engine_type("TurboEngine")
+
