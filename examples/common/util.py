@@ -23,6 +23,11 @@ import numpy as np
 import mxnet_tpu as mx
 
 
+def setup_logging():
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+
 def get_device():
     """The training device: the TPU when one is visible, else whatever
     JAX exposes (mx.tpu() already falls back to the default backend)."""
@@ -64,8 +69,7 @@ def lr_scheduler(args, epoch_size):
 def fit(args, network, train_iter, val_iter=None, label_names=None,
         initializer=None, epoch_size=None):
     """reference: common/fit.py fit — the standard training run."""
-    logging.basicConfig(level=logging.INFO,
-                        format="%(asctime)-15s %(message)s")
+    setup_logging()
     kv = args.kv_store
     devs = get_device()
     mod = mx.mod.Module(network, context=devs,

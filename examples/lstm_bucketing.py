@@ -15,7 +15,7 @@ corpus so the script always runs and the perplexity drop is real.
 import argparse
 import os
 
-from common.util import add_fit_args, get_device  # noqa: F401  (path bootstrap)
+from common.util import add_fit_args, get_device, setup_logging  # noqa: F401  (path bootstrap)
 
 import numpy as np
 
@@ -173,35 +173,32 @@ def main():
         print("no --data file — using a synthetic Markov corpus")
         sentences, vocab_size = synthetic_corpus()
 
+    setup_logging()
     it = BucketSentenceIter(sentences, args.batch_size)
     dev = get_device()
     mod = mx.mod.BucketingModule(
         make_sym_gen(vocab_size, args.num_embed, args.num_hidden,
                      args.num_layers),
         default_bucket_key=it.default_bucket_key, context=dev)
-    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     mx.random.seed(0)
     zeros = mx.nd.zeros((args.num_layers, args.batch_size, args.num_hidden))
-    mod.init_params(mx.initializer.Uniform(0.08),
-                    arg_params={"rnn_state": zeros,
-                                "rnn_state_cell": zeros.copy()})
-    mod.init_optimizer(kvstore=args.kv_store, optimizer="adam",
-                       optimizer_params={"learning_rate": args.lr})
-
     metric = mx.metric.Perplexity(ignore_label=0)
-    last_ppl = None
-    for epoch in range(args.num_epochs):
-        it.reset()
-        metric.reset()
-        for batch in it:
-            mod.forward(batch, is_train=True)
-            mod.backward()
-            mod.update()
-            mod.update_metric(metric, batch.label)
-        name, ppl = metric.get()
-        print(f"Epoch[{epoch}] Train-{name}={ppl:.2f}")
-        last_ppl = ppl
-    return last_ppl
+    # the reference workflow: BucketingModule straight through fit()
+    # (example/rnn/lstm_bucketing.py), batches routed per bucket_key
+    mod.fit(it, num_epoch=args.num_epochs,
+            kvstore=args.kv_store,
+            optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            eval_metric=metric,
+            initializer=mx.initializer.Uniform(0.08),
+            arg_params={"rnn_state": zeros,
+                        "rnn_state_cell": zeros.copy()},
+            allow_missing=True,
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, 50, auto_reset=False))
+    name, ppl = metric.get()
+    print(f"final Train-{name}={ppl:.2f}")
+    return ppl
 
 
 if __name__ == "__main__":

@@ -55,11 +55,16 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Log samples/sec every `frequent` batches (reference: callback.py:89)."""
+    """Log samples/sec every `frequent` batches (reference: callback.py:89).
 
-    def __init__(self, batch_size, frequent=50):
+    ``auto_reset=True`` resets the metric each report (the reference's
+    windowed behavior); ``False`` leaves the metric accumulating over
+    the whole epoch so epoch-end readings cover every batch."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
+        self.auto_reset = auto_reset
         self.init = False
         self.tic = 0
         self.last_count = 0
@@ -75,7 +80,8 @@ class Speedometer:
                 speed = self.frequent * self.batch_size / (time.time() - self.tic)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
-                    param.eval_metric.reset()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
                     for name, value in name_value:
                         logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f",
                                      param.epoch, count, speed, name, value)

@@ -61,7 +61,10 @@ def test_lstm_bucketing():
     out = run_example("lstm_bucketing.py", "--num-epochs", "3",
                       "--batch-size", "16", "--num-hidden", "32",
                       "--num-embed", "16")
-    lines = [l for l in out.splitlines() if "Perplexity" in l]
+    import re
+
+    lines = [l for l in out.splitlines()
+             if re.search(r"Epoch\[\d+\] Train-Perplexity=", l)]
     assert len(lines) == 3, out
     first = float(lines[0].rsplit("=", 1)[1])
     last = float(lines[-1].rsplit("=", 1)[1])
