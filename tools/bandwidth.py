@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Communication bandwidth benchmark — parity with the reference's
+``tools/bandwidth/`` kvstore measurement (perf.md:148-150), TPU-native:
+measures the costs that replace the reference's PCIe/ps-lite traffic.
+
+Measures, per tensor size:
+  h2d     — host→device transfer (the reference's CPU→GPU copy)
+  psum    — mesh all-reduce of a replicated-gradient psum over 'dp'
+            (the reference's kvstore push/reduce)
+  ppermute— neighbor exchange around the mesh ring (the ring-attention
+            rotation primitive)
+
+    python tools/bandwidth.py --sizes 1,8,64 --mesh 8
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/bandwidth.py    # virtual-mesh smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def measure(fn, arg, iters=20):
+    import jax
+
+    jax.block_until_ready(fn(arg))  # compile + warm
+    t0 = time.time()
+    # keep every result and block the whole list: readiness of the last
+    # dispatch does not imply earlier overlapped transfers finished
+    jax.block_until_ready([fn(arg) for _ in range(iters)])
+    return (time.time() - t0) / iters
+
+
+def main():
+    parser = argparse.ArgumentParser(description="bandwidth benchmark")
+    parser.add_argument("--sizes", type=str, default="1,4,16,64",
+                        help="tensor sizes in MB")
+    parser.add_argument("--mesh", type=int, default=0,
+                        help="devices in the mesh (0 = all)")
+    parser.add_argument("--iters", type=int, default=20)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    n = min(args.mesh or len(devices), len(devices))
+    devices = devices[:n]
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    print(f"devices: {n} x {devices[0].device_kind}")
+    print(f"{'MB':>8} {'h2d GB/s':>10} {'psum GB/s':>10} {'ppermute GB/s':>14}")
+
+    for mb in (float(x) for x in args.sizes.split(",")):
+        elems = int(mb * 1e6 / 4)
+        host = np.random.rand(elems).astype(np.float32)
+        nbytes = host.nbytes
+
+        # h2d
+        dt = measure(lambda h: jax.device_put(h, devices[0]), host,
+                     args.iters)
+        h2d = nbytes / dt / 1e9
+
+        # psum over the mesh (per-device shard all-reduced)
+        shard = np.random.rand(max(elems // n, 1)).astype(np.float32)
+        sharded = jax.device_put(
+            np.tile(shard, n), NamedSharding(mesh, P("dp")))
+        mesh_bytes = sharded.nbytes  # actual measured array size
+
+        psum_fn = jax.jit(
+            jax.shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+                          in_specs=P("dp"), out_specs=P()))
+        dt = measure(psum_fn, sharded, args.iters)
+        psum = mesh_bytes / dt / 1e9
+
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        pp_fn = jax.jit(
+            jax.shard_map(lambda x: jax.lax.ppermute(x, "dp", perm),
+                          mesh=mesh, in_specs=P("dp"), out_specs=P("dp")))
+        dt = measure(pp_fn, sharded, args.iters)
+        pperm = mesh_bytes / dt / 1e9
+
+        print(f"{mb:8.1f} {h2d:10.2f} {psum:10.2f} {pperm:14.2f}")
+
+
+if __name__ == "__main__":
+    main()
