@@ -28,7 +28,8 @@ def _build() -> bool:
     try:
         os.makedirs(os.path.dirname(_SO), exist_ok=True)
         subprocess.run(
-            ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o", _SO, _SRC],
+            ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread", "-shared",
+             "-o", _SO, _SRC],
             check=True, capture_output=True, timeout=120)
         return True
     except Exception:
@@ -48,31 +49,55 @@ def lib():
             if not _build():
                 return None
         try:
-            L = ctypes.CDLL(_SO)
+            L = _declare(ctypes.CDLL(_SO))
         except OSError:
             return None
-        L.MXTPURecordIOWriterCreate.restype = ctypes.c_void_p
-        L.MXTPURecordIOWriterCreate.argtypes = [ctypes.c_char_p]
-        L.MXTPURecordIOWriterWrite.restype = ctypes.c_int
-        L.MXTPURecordIOWriterWrite.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
-        L.MXTPURecordIOWriterTell.restype = ctypes.c_int64
-        L.MXTPURecordIOWriterTell.argtypes = [ctypes.c_void_p]
-        L.MXTPURecordIOWriterFree.restype = None
-        L.MXTPURecordIOWriterFree.argtypes = [ctypes.c_void_p]
-        L.MXTPURecordIOReaderCreate.restype = ctypes.c_void_p
-        L.MXTPURecordIOReaderCreate.argtypes = [ctypes.c_char_p]
-        L.MXTPURecordIOReaderRead.restype = ctypes.c_void_p
-        L.MXTPURecordIOReaderRead.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
-        L.MXTPURecordIOReaderSeek.restype = ctypes.c_int
-        L.MXTPURecordIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        L.MXTPURecordIOReaderTell.restype = ctypes.c_int64
-        L.MXTPURecordIOReaderTell.argtypes = [ctypes.c_void_p]
-        L.MXTPURecordIOReaderFree.restype = None
-        L.MXTPURecordIOReaderFree.argtypes = [ctypes.c_void_p]
-        L.MXTPURecordIOScan.restype = ctypes.c_int64
-        L.MXTPURecordIOScan.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        except AttributeError:
+            # stale prebuilt .so missing newer symbols: rebuild once
+            if not os.path.exists(_SRC) or not _build():
+                return None
+            try:
+                L = _declare(ctypes.CDLL(_SO))
+            except (OSError, AttributeError):
+                return None
         _lib = L
         return _lib
+
+
+def _declare(L):
+    L.MXTPURecordIOWriterCreate.restype = ctypes.c_void_p
+    L.MXTPURecordIOWriterCreate.argtypes = [ctypes.c_char_p]
+    L.MXTPURecordIOWriterWrite.restype = ctypes.c_int
+    L.MXTPURecordIOWriterWrite.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    L.MXTPURecordIOWriterTell.restype = ctypes.c_int64
+    L.MXTPURecordIOWriterTell.argtypes = [ctypes.c_void_p]
+    L.MXTPURecordIOWriterFree.restype = None
+    L.MXTPURecordIOWriterFree.argtypes = [ctypes.c_void_p]
+    L.MXTPURecordIOReaderCreate.restype = ctypes.c_void_p
+    L.MXTPURecordIOReaderCreate.argtypes = [ctypes.c_char_p]
+    L.MXTPURecordIOReaderRead.restype = ctypes.c_void_p
+    L.MXTPURecordIOReaderRead.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    L.MXTPURecordIOReaderSeek.restype = ctypes.c_int
+    L.MXTPURecordIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    L.MXTPURecordIOReaderTell.restype = ctypes.c_int64
+    L.MXTPURecordIOReaderTell.argtypes = [ctypes.c_void_p]
+    L.MXTPURecordIOReaderFree.restype = None
+    L.MXTPURecordIOReaderFree.argtypes = [ctypes.c_void_p]
+    L.MXTPURecordIOScan.restype = ctypes.c_int64
+    L.MXTPURecordIOScan.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+    L.MXTPUBatchRead.restype = ctypes.c_void_p
+    L.MXTPUBatchRead.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.c_int]
+    L.MXTPUBatchData.restype = ctypes.c_void_p
+    L.MXTPUBatchData.argtypes = [ctypes.c_void_p]
+    L.MXTPUBatchSizes.restype = ctypes.POINTER(ctypes.c_int64)
+    L.MXTPUBatchSizes.argtypes = [ctypes.c_void_p]
+    L.MXTPUBatchStarts.restype = ctypes.POINTER(ctypes.c_int64)
+    L.MXTPUBatchStarts.argtypes = [ctypes.c_void_p]
+    L.MXTPUBatchFree.restype = None
+    L.MXTPUBatchFree.argtypes = [ctypes.c_void_p]
+    return L

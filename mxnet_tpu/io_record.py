@@ -150,10 +150,11 @@ class ImageRecordIter(DataIter):
             raise MXNetError("truncated record file")
         return s
 
-    def _decode_one(self, offset):
+    def _decode_one(self, offset, payload=None):
         c = self.data_shape[0]
-        header, img = rio.unpack_img(self._read_at(offset),
-                                     iscolor=0 if c == 1 else 1)
+        if payload is None:
+            payload = self._read_at(offset)
+        header, img = rio.unpack_img(payload, iscolor=0 if c == 1 else 1)
         if c == 1:
             img = img[:, :, None]  # HW -> HW1
         else:
@@ -232,8 +233,18 @@ class ImageRecordIter(DataIter):
                     [idxs, self._order[np.arange(pad) % self.num_data]])
         self._cursor = stop
 
-        decoded = list(self._pool.map(self._decode_one,
-                                      self._offsets[idxs]))
+        offsets = self._offsets[idxs]
+        from . import _native
+        if _native.lib() is not None:
+            # one native threaded call fetches all payloads (no
+            # per-record Python seek/read); decode+augment still fan
+            # out over the pool
+            payloads = rio.read_batch(self._path_imgrec, offsets)
+            decoded = list(self._pool.map(self._decode_one, offsets,
+                                          payloads))
+        else:
+            # pure-Python fallback: per-thread cached readers in the pool
+            decoded = list(self._pool.map(self._decode_one, offsets))
         data = np.stack([d for d, _ in decoded])
         label = np.stack([l for _, l in decoded])
         if self.label_width == 1:

@@ -18,6 +18,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -149,6 +150,92 @@ int64_t MXTPURecordIOScan(const char* path, int64_t* offsets, int64_t cap) {
   }
   std::fclose(fp);
   return n;
+}
+
+// ---------------------------------------------------------------------------
+// Batched random-access read: fetch n records (given their start
+// offsets) with an internal thread pool — one native call per batch
+// instead of n Python seek+read round trips.  Each worker owns its own
+// FILE* so reads are position-independent.
+// ---------------------------------------------------------------------------
+
+struct BatchBuffer {
+  std::vector<char> data;        // payloads, concatenated
+  std::vector<int64_t> sizes;    // per-record payload sizes (-1 = error)
+  std::vector<int64_t> starts;   // offsets of payloads inside data
+};
+
+// Reads the records at `offsets[0..n)` of `path` using `threads`
+// workers.  Returns an opaque handle (free with MXTPUBatchFree), or
+// nullptr when the file cannot be opened.  Per-record framing errors
+// are reported as size -1 for that record only.
+void* MXTPUBatchRead(const char* path, const int64_t* offsets, int64_t n,
+                     int threads) {
+  // pass 1: read headers to learn payload sizes (cheap, sequential)
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return nullptr;
+  auto* out = new BatchBuffer;
+  out->sizes.assign(n, -1);
+  out->starts.assign(n, 0);
+  std::vector<uint32_t> lens(n, 0);
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t header[2];
+    if (std::fseek(fp, offsets[i], SEEK_SET) != 0 ||
+        std::fread(header, sizeof(uint32_t), 2, fp) != 2 ||
+        header[0] != kMagic || (header[1] >> 29) != 0) {
+      continue;  // sizes[i] stays -1
+    }
+    lens[i] = header[1] & kLenMask;
+    out->sizes[i] = lens[i];
+    out->starts[i] = total;
+    total += lens[i];
+  }
+  std::fclose(fp);
+  out->data.resize(total);
+
+  // pass 2: parallel payload reads
+  if (threads < 1) threads = 1;
+  if (threads > n) threads = static_cast<int>(n > 0 ? n : 1);
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  for (int t = 0; t < threads; ++t) {
+    pool.emplace_back([&, t]() {
+      FILE* f = std::fopen(path, "rb");
+      if (!f) {
+        // no fd: this worker's records must not pass as zero-filled data
+        for (int64_t i = t; i < n; i += threads) out->sizes[i] = -1;
+        return;
+      }
+      for (int64_t i = t; i < n; i += threads) {
+        if (out->sizes[i] < 0 || lens[i] == 0) continue;
+        if (std::fseek(f, offsets[i] + 8, SEEK_SET) != 0 ||
+            std::fread(out->data.data() + out->starts[i], 1, lens[i], f)
+                != lens[i]) {
+          out->sizes[i] = -1;
+        }
+      }
+      std::fclose(f);
+    });
+  }
+  for (auto& th : pool) th.join();
+  return out;
+}
+
+const char* MXTPUBatchData(void* h) {
+  return static_cast<BatchBuffer*>(h)->data.data();
+}
+
+const int64_t* MXTPUBatchSizes(void* h) {
+  return static_cast<BatchBuffer*>(h)->sizes.data();
+}
+
+const int64_t* MXTPUBatchStarts(void* h) {
+  return static_cast<BatchBuffer*>(h)->starts.data();
+}
+
+void MXTPUBatchFree(void* h) {
+  delete static_cast<BatchBuffer*>(h);
 }
 
 }  // extern "C"

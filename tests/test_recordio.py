@@ -296,3 +296,31 @@ def test_image_record_iter_grayscale_resize(tmp_path):
         mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
                               data_shape=(1, 28, 28), batch_size=4,
                               random_h=10)
+
+
+def test_read_batch_native(tmp_path):
+    """Batched native reads return the same payloads as sequential reads."""
+    path = str(tmp_path / "b.rec")
+    w = rio.MXRecordIO(path, "w")
+    recs = [bytes([i]) * (i * 7 + 1) for i in range(20)]
+    for r in recs:
+        w.write(r)
+    w.close()
+    offsets = rio.list_records(path)
+    # arbitrary order incl. duplicates
+    order = [3, 0, 19, 7, 7, 12]
+    out = rio.read_batch(path, [offsets[i] for i in order], threads=3)
+    assert out == [recs[i] for i in order]
+    with pytest.raises(Exception, match="corrupt|open"):
+        rio.read_batch(path, [5], threads=1)  # misaligned offset
+
+
+def test_read_batch_empty_records(tmp_path):
+    path = str(tmp_path / "e2.rec")
+    w = rio.MXRecordIO(path, "w")
+    for r in (b"", b"", b"x"):
+        w.write(r)
+    w.close()
+    offsets = rio.list_records(path)
+    assert rio.read_batch(path, offsets[:2]) == [b"", b""]  # all-empty batch
+    assert rio.read_batch(path, offsets) == [b"", b"", b"x"]

@@ -18,8 +18,6 @@ Measures, per tensor size:
 from __future__ import annotations
 
 import argparse
-import os
-import sys
 import time
 
 import numpy as np
