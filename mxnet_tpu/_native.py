@@ -53,7 +53,13 @@ def lib():
         except OSError:
             return None
         except AttributeError:
-            # stale prebuilt .so missing newer symbols: rebuild once
+            # stale prebuilt .so missing newer symbols: rebuild once.
+            # unlink first — glibc dlopen dedupes by (dev, ino), so
+            # rebuilding in place would hand back the stale mapping
+            try:
+                os.unlink(_SO)
+            except OSError:
+                return None
             if not os.path.exists(_SRC) or not _build():
                 return None
             try:

@@ -48,7 +48,8 @@ class ImageRecordIter(DataIter):
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size,
-                 path_imgidx=None, label_width=1, shuffle=False, seed=0,
+                 path_imgidx=None, path_imglist=None, label_width=1,
+                 shuffle=False, seed=0,
                  mean_img=None, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=0.0, std_g=0.0, std_b=0.0, scale=1.0,
                  resize=0, rand_crop=False, rand_resize=False,
@@ -88,6 +89,22 @@ class ImageRecordIter(DataIter):
         self._tls = threading.local()
         self._readers = []
         self._readers_lock = threading.Lock()
+
+        # --- optional label map: image id -> fresh labels, overriding
+        # the labels packed in the records (reference: "supply a list
+        # file that maps image id to new labels",
+        # src/io/image_recordio.h:24-30 + iter_image_recordio.cc:29-90)
+        self._label_map = None
+        if path_imglist:
+            self._label_map = {}
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    self._label_map[int(parts[0])] = np.asarray(
+                        [float(x) for x in parts[1:1 + label_width]],
+                        np.float32)
 
         # --- record offsets, sharded across workers -------------------
         if path_imgidx and os.path.isfile(path_imgidx):
@@ -130,7 +147,8 @@ class ImageRecordIter(DataIter):
         if mean_img:
             self._mean = self._load_or_compute_mean(mean_img)
 
-        self._pool = ThreadPoolExecutor(max_workers=max(1, preprocess_threads))
+        self._preprocess_threads = max(1, preprocess_threads)
+        self._pool = ThreadPoolExecutor(max_workers=self._preprocess_threads)
         self._order = np.arange(self.num_data)
         self._cursor = 0
         self._seen_epoch_end = False
@@ -167,7 +185,16 @@ class ImageRecordIter(DataIter):
             img = aug(img, rng)
             if img.ndim == 2:
                 img = img[:, :, None]  # cv2 ops drop the dim of (H,W,1)
-        label = header.label
+        if self._label_map is not None:
+            label = self._label_map.get(header.id)
+            if label is None:
+                # mixing remapped and packed labels would silently train
+                # on wrong data (the reference's ImageLabelMap::Find
+                # hard-fails the same way)
+                raise MXNetError(
+                    f"image id {header.id} not found in path_imglist")
+        else:
+            label = header.label
         if isinstance(label, np.ndarray):
             label = label[:self.label_width]
         else:
@@ -239,7 +266,8 @@ class ImageRecordIter(DataIter):
             # one native threaded call fetches all payloads (no
             # per-record Python seek/read); decode+augment still fan
             # out over the pool
-            payloads = rio.read_batch(self._path_imgrec, offsets)
+            payloads = rio.read_batch(self._path_imgrec, offsets,
+                                      threads=self._preprocess_threads)
             decoded = list(self._pool.map(self._decode_one, offsets,
                                           payloads))
         else:

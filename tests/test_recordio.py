@@ -324,3 +324,33 @@ def test_read_batch_empty_records(tmp_path):
     offsets = rio.list_records(path)
     assert rio.read_batch(path, offsets[:2]) == [b"", b""]  # all-empty batch
     assert rio.read_batch(path, offsets) == [b"", b"", b"x"]
+
+
+def test_image_record_iter_label_map(tmp_path):
+    """path_imglist relabels records without repacking (reference:
+    image_recordio.h:24-30)."""
+    prefix = _make_color_dataset(tmp_path, n=8)
+    lst = tmp_path / "relabel.lst"
+    # flip every label: id i -> 1 - (i % 2)
+    lst.write_text("".join(f"{i}\t{1 - (i % 2)}\t-\n" for i in range(8)))
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", path_imglist=str(lst),
+        data_shape=(3, 32, 32), batch_size=8, preprocess_threads=1)
+    b = next(iter(it))
+    idxs = b.index
+    labels = b.label[0].asnumpy()
+    for pos, i in enumerate(idxs):
+        assert labels[pos] == 1 - (int(i) % 2)
+    it.close()
+
+
+def test_image_record_iter_label_map_missing_id(tmp_path):
+    prefix = _make_color_dataset(tmp_path, n=4)
+    lst = tmp_path / "partial.lst"
+    lst.write_text("0\t1\t-\n")  # only id 0 remapped
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", path_imglist=str(lst),
+        data_shape=(3, 32, 32), batch_size=4, preprocess_threads=1)
+    with pytest.raises(Exception, match="not found in path_imglist"):
+        next(iter(it))
+    it.close()
