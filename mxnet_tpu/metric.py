@@ -116,15 +116,62 @@ def _as_np(x):
     return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
 
 
+def _device_correct_count(pred, label):
+    """Jitted on-device correct-prediction count (retraces per shape)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def count(p, l):
+        if p.ndim > l.ndim or (p.ndim == l.ndim and p.shape != l.shape):
+            p = jnp.argmax(p, axis=-1)
+        return jnp.sum(p.astype(jnp.int32).reshape(-1)
+                       == l.astype(jnp.int32).reshape(-1))
+
+    return count(pred, label)
+
+
 class Accuracy(EvalMetric):
-    """Classification accuracy (reference: metric.py:109)."""
+    """Classification accuracy (reference: metric.py:109).
+
+    TPU-first accumulation: NDArray inputs are scored ON DEVICE (one
+    jitted count per batch, accumulated into a device scalar) — the
+    full prediction tensor never transfers to the host; ``get()``
+    fetches a single scalar.  Non-NDArray inputs use the reference's
+    numpy path."""
 
     def __init__(self):
         super().__init__("accuracy")
+        self._dev_sum = None
+
+    def reset(self):
+        super().reset()
+        self._dev_sum = None
+
+    def _drain_device(self):
+        if self._dev_sum is not None:
+            self.sum_metric += float(self._dev_sum)
+            self._dev_sum = None
+
+    def get(self):
+        self._drain_device()
+        return super().get()
 
     def update(self, labels, preds):
+        from .ndarray import NDArray
+
         check_label_shapes(labels, preds)
         for label, pred_label in zip(labels, preds):
+            if isinstance(label, NDArray) and isinstance(pred_label, NDArray) \
+                    and pred_label._data.devices() == label._data.devices():
+                # (mismatched placements — e.g. mesh-sharded preds with a
+                # single-device label — take the host path below)
+                n = int(numpy.prod(label.shape)) if label.shape else 1
+                correct = _device_correct_count(pred_label._data, label._data)
+                self._dev_sum = correct if self._dev_sum is None \
+                    else self._dev_sum + correct
+                self.num_inst += n
+                continue
             pred_label = _as_np(pred_label)
             label = _as_np(label)
             if pred_label.ndim > label.ndim or (

@@ -80,3 +80,20 @@ def test_perplexity():
     m.update(label, pred)
     expected = np.exp(-(np.log(0.5) + np.log(0.75)) / 2)
     assert abs(m.get()[1] - expected) < 1e-5
+
+
+def test_accuracy_device_accumulation_matches_numpy():
+    """NDArray inputs score on device; result identical to the numpy path."""
+    rng = np.random.RandomState(0)
+    m_dev = mx.metric.Accuracy()
+    m_np = mx.metric.Accuracy()
+    for _ in range(3):
+        pred = rng.rand(16, 5).astype(np.float32)
+        label = rng.randint(0, 5, 16).astype(np.float32)
+        m_dev.update([mx.nd.array(label)], [mx.nd.array(pred)])
+        m_np.update([label], [pred])
+    assert m_dev._dev_sum is not None  # really accumulated on device
+    assert m_dev.get() == m_np.get()
+    # reset clears the device accumulator
+    m_dev.reset()
+    assert m_dev.get()[1] != m_dev.get()[1] or m_dev.num_inst == 0
