@@ -116,19 +116,27 @@ def _as_np(x):
     return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
 
 
+_device_count_jit = None
+
+
 def _device_correct_count(pred, label):
-    """Jitted on-device correct-prediction count (retraces per shape)."""
-    import jax
-    import jax.numpy as jnp
+    """On-device correct-prediction count.  The jitted callable is a
+    module-level singleton so its compile cache persists across update()
+    calls (retraces only per input shape)."""
+    global _device_count_jit
+    if _device_count_jit is None:
+        import jax
+        import jax.numpy as jnp
 
-    @jax.jit
-    def count(p, l):
-        if p.ndim > l.ndim or (p.ndim == l.ndim and p.shape != l.shape):
-            p = jnp.argmax(p, axis=-1)
-        return jnp.sum(p.astype(jnp.int32).reshape(-1)
-                       == l.astype(jnp.int32).reshape(-1))
+        @jax.jit
+        def count(p, l):
+            if p.ndim > l.ndim or (p.ndim == l.ndim and p.shape != l.shape):
+                p = jnp.argmax(p, axis=-1)
+            return jnp.sum(p.astype(jnp.int32).reshape(-1)
+                           == l.astype(jnp.int32).reshape(-1))
 
-    return count(pred, label)
+        _device_count_jit = count
+    return _device_count_jit(pred, label)
 
 
 class Accuracy(EvalMetric):
@@ -158,15 +166,19 @@ class Accuracy(EvalMetric):
         return super().get()
 
     def update(self, labels, preds):
-        from .ndarray import NDArray
-
         check_label_shapes(labels, preds)
         for label, pred_label in zip(labels, preds):
+            ps = tuple(pred_label.shape)
+            ls = tuple(label.shape)
             if isinstance(label, NDArray) and isinstance(pred_label, NDArray) \
-                    and pred_label._data.devices() == label._data.devices():
-                # (mismatched placements — e.g. mesh-sharded preds with a
-                # single-device label — take the host path below)
-                n = int(numpy.prod(label.shape)) if label.shape else 1
+                    and pred_label._data.devices() == label._data.devices() \
+                    and (ps == ls or (len(ps) == len(ls) + 1
+                                      and ps[:-1] == ls)):
+                # clean elementwise / trailing-class-axis cases run on
+                # device; anything else (mismatched placements, odd
+                # shape combos, shape errors) takes the host path below
+                # with the reference's full semantics and error messages
+                n = int(numpy.prod(ls)) if ls else 1
                 correct = _device_correct_count(pred_label._data, label._data)
                 self._dev_sum = correct if self._dev_sum is None \
                     else self._dev_sum + correct

@@ -96,4 +96,4 @@ def test_accuracy_device_accumulation_matches_numpy():
     assert m_dev.get() == m_np.get()
     # reset clears the device accumulator
     m_dev.reset()
-    assert m_dev.get()[1] != m_dev.get()[1] or m_dev.num_inst == 0
+    assert m_dev._dev_sum is None and m_dev.num_inst == 0
