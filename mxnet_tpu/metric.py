@@ -261,9 +261,77 @@ class Perplexity(EvalMetric):
         super().__init__("Perplexity")
         self.ignore_label = ignore_label
         self.axis = axis
+        self._dev_sum = None   # device-accumulated weighted perplexity
+        self._dev_num = None   # device-accumulated token count
+        self._dev_fn = None
+
+    def reset(self):
+        super().reset()
+        self._dev_sum = None
+        self._dev_num = None
+
+    def _drain_device(self):
+        if self._dev_sum is not None:
+            self.sum_metric += float(self._dev_sum)
+            self.num_inst += int(self._dev_num)
+            self._dev_sum = None
+            self._dev_num = None
+
+    def get(self):
+        self._drain_device()
+        return super().get()
+
+    def _device_update(self, pred, label):
+        """(exp(loss/n)*n, n) computed on device — the prediction tensor
+        never transfers to host; jit cached per instance (ignore_label
+        is a trace-time constant).  Note: out-of-range label values
+        clamp under the device gather (JAX semantics) rather than
+        raising like the numpy path."""
+        if self._dev_fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            ignore_label = self.ignore_label
+
+            @jax.jit
+            def f(p, l):
+                l = l.reshape(-1).astype(jnp.int32)
+                p = p.reshape(-1, p.shape[-1])
+                probs = p[jnp.arange(l.shape[0]), l]
+                n = l.shape[0]
+                if ignore_label is not None:
+                    ignore = l == int(ignore_label)
+                    probs = jnp.where(ignore, 1.0, probs)
+                    n = n - jnp.sum(ignore)
+                loss = -jnp.sum(jnp.log(jnp.maximum(1e-10, probs)))
+                # all-ignored batch: contribute nothing (the host path's
+                # 'if num:' guard), never NaN from exp(0/0)*0
+                ppl = jnp.where(n > 0,
+                                jnp.exp(loss / jnp.maximum(n, 1)) * n, 0.0)
+                return ppl, n
+
+            self._dev_fn = f
+        return self._dev_fn(pred, label)
 
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
+        # the host formula applies ONE exp over the combined loss of all
+        # pairs in this call; per-pair exp differs by Jensen whenever
+        # losses differ, so the device path only takes the (universal)
+        # single-pair call, with strict shape gating like Accuracy's
+        if (len(labels) == 1
+                and isinstance(labels[0], NDArray)
+                and isinstance(preds[0], NDArray)
+                and preds[0]._data.devices() == labels[0]._data.devices()
+                and preds[0].ndim >= 2
+                and int(numpy.prod(preds[0].shape[:-1]))
+                == int(numpy.prod(labels[0].shape))):
+            ppl, n = self._device_update(preds[0]._data, labels[0]._data)
+            self._dev_sum = ppl if self._dev_sum is None \
+                else self._dev_sum + ppl
+            self._dev_num = n if self._dev_num is None \
+                else self._dev_num + n
+            return
         loss = 0.0
         num = 0
         for label, pred in zip(labels, preds):
@@ -277,8 +345,9 @@ class Perplexity(EvalMetric):
                 num -= ignore.sum()
             loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
             num += label.shape[0]
-        self.sum_metric += numpy.exp(loss / num) * num
-        self.num_inst += num
+        if num:
+            self.sum_metric += numpy.exp(loss / num) * num
+            self.num_inst += num
 
 
 class MAE(EvalMetric):

@@ -97,3 +97,48 @@ def test_accuracy_device_accumulation_matches_numpy():
     # reset clears the device accumulator
     m_dev.reset()
     assert m_dev._dev_sum is None and m_dev.num_inst == 0
+
+
+def test_perplexity_device_accumulation_matches_numpy():
+    rng = np.random.RandomState(1)
+    m_dev = mx.metric.Perplexity(ignore_label=0)
+    m_np = mx.metric.Perplexity(ignore_label=0)
+    for _ in range(3):
+        pred = rng.rand(24, 7).astype(np.float32)
+        pred /= pred.sum(axis=1, keepdims=True)
+        label = rng.randint(0, 7, 24).astype(np.float32)
+        m_dev.update([mx.nd.array(label).reshape((4, 6))],
+                     [mx.nd.array(pred)])
+        m_np.update([label], [pred])
+    assert m_dev._dev_sum is not None
+    name, a = m_dev.get()
+    _, b = m_np.get()
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+    m_dev.reset()
+    assert m_dev._dev_sum is None and m_dev.num_inst == 0
+
+
+def test_perplexity_device_all_ignored_batch():
+    """An all-padding batch contributes nothing (no NaN poisoning)."""
+    m = mx.metric.Perplexity(ignore_label=0)
+    pred = np.full((4, 3), 1 / 3, np.float32)
+    m.update([mx.nd.zeros((4,))], [mx.nd.array(pred)])  # all ignored
+    label = np.array([1, 2, 1, 2], np.float32)
+    m.update([mx.nd.array(label)], [mx.nd.array(pred)])
+    _, v = m.get()
+    assert np.isfinite(v) and abs(v - 3.0) < 1e-4  # uniform over 3
+
+
+def test_perplexity_multi_pair_uses_combined_exp():
+    """Multiple (label, pred) pairs keep the host combined-exp formula."""
+    rng = np.random.RandomState(2)
+    p1 = rng.rand(8, 4).astype(np.float32); p1 /= p1.sum(1, keepdims=True)
+    p2 = rng.rand(8, 4).astype(np.float32); p2 /= p2.sum(1, keepdims=True)
+    l1 = rng.randint(0, 4, 8).astype(np.float32)
+    l2 = rng.randint(0, 4, 8).astype(np.float32)
+    m_nd = mx.metric.Perplexity()
+    m_np = mx.metric.Perplexity()
+    m_nd.update([mx.nd.array(l1), mx.nd.array(l2)],
+                [mx.nd.array(p1), mx.nd.array(p2)])
+    m_np.update([l1, l2], [p1, p2])
+    np.testing.assert_allclose(m_nd.get()[1], m_np.get()[1], rtol=1e-6)
