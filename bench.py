@@ -207,8 +207,9 @@ def main():
         window_ms.append((time.time() - t0) / per_window * 1000)
         steps_done += per_window
     dt = min(window_ms) / 1000 * iters  # best-window rate over all steps
+    step_ms_median = float(np.median(window_ms))
     log("window ms/step: " + ", ".join(f"{m:.2f}" for m in window_ms)
-        + " (reporting best window)")
+        + f" (best window headline; median {step_ms_median:.2f})")
     # the timing loop restarted its batch index at 0, so the last
     # output corresponds to batch (steps_done - 1) % n_batches
     loss_last = _ce_loss(mod.get_outputs()[0].asnumpy(),
@@ -244,11 +245,15 @@ def main():
         "metric": "resnet50_train_throughput",
         "value": round(img_s, 2),
         "unit": "img/s/chip",
+        # vs_baseline compares this run (precision above) against the
+        # reference's fp32 P100 number — not like-for-like when bf16
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "baseline_precision": "fp32",
         "mfu": mfu,
         "precision": PRECISION,
         "tflops": round(tflops, 1),
         "step_ms": round(step_ms, 3),
+        "step_ms_median": round(step_ms_median, 3),
         "step_ms_sync": round(dt_sync * 1000, 3),
         "loss_first": round(loss_first, 4),
         "loss_last": round(loss_last, 4),

@@ -25,12 +25,28 @@ _tried = False
 
 
 def _build() -> bool:
+    # cross-process safe: serialize on an flock'd sidecar, compile to a
+    # per-pid temp path, then atomically rename into place — concurrent
+    # launcher workers never dlopen a half-written .so
     try:
+        import fcntl
         os.makedirs(os.path.dirname(_SO), exist_ok=True)
-        subprocess.run(
-            ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread", "-shared",
-             "-o", _SO, _SRC],
-            check=True, capture_output=True, timeout=120)
+        with open(_SO + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            if os.path.exists(_SO) and (
+                    not os.path.exists(_SRC)
+                    or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+                return True  # another process built it while we waited
+            tmp = f"{_SO}.{os.getpid()}.tmp"
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-fPIC", "-std=c++17", "-pthread",
+                     "-shared", "-o", tmp, _SRC],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _SO)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
         return True
     except Exception:
         return False

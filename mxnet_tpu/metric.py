@@ -151,15 +151,21 @@ class Accuracy(EvalMetric):
     def __init__(self):
         super().__init__("accuracy")
         self._dev_sum = None
+        self._dev_num = 0
 
     def reset(self):
         super().reset()
         self._dev_sum = None
+        self._dev_num = 0
 
     def _drain_device(self):
+        # sum_metric and num_inst stay mutually coherent: both device
+        # contributions land together at drain time, never one at a time
         if self._dev_sum is not None:
             self.sum_metric += float(self._dev_sum)
+            self.num_inst += self._dev_num
             self._dev_sum = None
+            self._dev_num = 0
 
     def get(self):
         self._drain_device()
@@ -182,7 +188,7 @@ class Accuracy(EvalMetric):
                 correct = _device_correct_count(pred_label._data, label._data)
                 self._dev_sum = correct if self._dev_sum is None \
                     else self._dev_sum + correct
-                self.num_inst += n
+                self._dev_num += n
                 continue
             pred_label = _as_np(pred_label)
             label = _as_np(label)
@@ -293,9 +299,12 @@ class Perplexity(EvalMetric):
 
             ignore_label = self.ignore_label
 
+            axis = self.axis
+
             @jax.jit
             def f(p, l):
                 l = l.reshape(-1).astype(jnp.int32)
+                p = jnp.moveaxis(p, axis, -1)
                 p = p.reshape(-1, p.shape[-1])
                 probs = p[jnp.arange(l.shape[0]), l]
                 n = l.shape[0]
@@ -324,7 +333,8 @@ class Perplexity(EvalMetric):
                 and isinstance(preds[0], NDArray)
                 and preds[0]._data.devices() == labels[0]._data.devices()
                 and preds[0].ndim >= 2
-                and int(numpy.prod(preds[0].shape[:-1]))
+                and int(numpy.prod(preds[0].shape))
+                // int(preds[0].shape[self.axis])
                 == int(numpy.prod(labels[0].shape))):
             ppl, n = self._device_update(preds[0]._data, labels[0]._data)
             self._dev_sum = ppl if self._dev_sum is None \
@@ -336,7 +346,7 @@ class Perplexity(EvalMetric):
         num = 0
         for label, pred in zip(labels, preds):
             label = _as_np(label).reshape(-1).astype("int32")
-            pred = _as_np(pred)
+            pred = numpy.moveaxis(_as_np(pred), self.axis, -1)
             pred = pred.reshape(-1, pred.shape[-1])
             probs = pred[numpy.arange(label.shape[0]), label]
             if self.ignore_label is not None:

@@ -142,3 +142,31 @@ def test_perplexity_multi_pair_uses_combined_exp():
                 [mx.nd.array(p1), mx.nd.array(p2)])
     m_np.update([l1, l2], [p1, p2])
     np.testing.assert_allclose(m_nd.get()[1], m_np.get()[1], rtol=1e-6)
+
+
+def test_perplexity_honors_axis():
+    # axis=1 on 3D predictions: class axis in the middle (ADVICE r3)
+    rng = np.random.RandomState(3)
+    p = rng.rand(4, 7, 5).astype(np.float32)  # (batch, classes, time)
+    p /= p.sum(axis=1, keepdims=True)
+    l = rng.randint(0, 7, size=(4, 5)).astype(np.float32)
+    m_ax = mx.metric.Perplexity(ignore_label=None, axis=1)
+    m_ax.update([l], [p])
+    m_ref = mx.metric.Perplexity(ignore_label=None)
+    m_ref.update([l], [np.moveaxis(p, 1, -1)])
+    np.testing.assert_allclose(m_ax.get()[1], m_ref.get()[1], rtol=1e-6)
+    # device path with axis=1 agrees too
+    m_dev = mx.metric.Perplexity(ignore_label=None, axis=1)
+    m_dev.update([mx.nd.array(l)], [mx.nd.array(p)])
+    np.testing.assert_allclose(m_dev.get()[1], m_ref.get()[1], rtol=1e-5)
+
+
+def test_accuracy_fields_coherent_mid_epoch():
+    # sum_metric/num_inst must be mutually coherent before get() (ADVICE r3)
+    m = mx.metric.Accuracy()
+    l = mx.nd.array(np.array([0.0, 1.0, 1.0, 0.0]))
+    p = mx.nd.array(np.eye(2)[[0, 1, 0, 0]].astype(np.float32))
+    m.update([l], [p])
+    # public fields read together mid-epoch: either both updated or neither
+    assert (m.num_inst == 0) == (m.sum_metric == 0.0)
+    assert m.get()[1] == 0.75
