@@ -13,10 +13,14 @@ TPU-first mapping (SURVEY §5.8):
   push/pull become XLA collectives inside the training program (see
   mxnet_tpu.parallel).  Exposed here so ``kvstore='tpu'`` works as a
   Module argument.
-* 'dist_*' — multi-host: same mesh programs over DCN via the JAX
-  distributed runtime (jax.distributed.initialize); rank/size map to
-  process_index/process_count.  Sync semantics are bulk-synchronous
-  like the reference's sync mode (kvstore_dist_server.h:164-198).
+* 'dist_sync' — multi-host bulk-synchronous: every worker computes the
+  identical global gradient sum (allgather over DCN) and runs a
+  replicated updater, matching the reference sync server's
+  apply-after-all-pushes semantics (kvstore_dist_server.h:164-198).
+* 'dist_async' — a real parameter server (mxnet_tpu.ps) on rank 0
+  applying each push on arrival with pulls returning current weights —
+  the reference async branch (kvstore_dist_server.h:199-207); no
+  barrier anywhere, stragglers never stall fast workers.
 """
 
 from __future__ import annotations
@@ -177,12 +181,9 @@ class DistKVStore(TPUKVStore):
         # initialize the XLA backend (jax.distributed.initialize must
         # run first in the process); only attempted when the launcher
         # (tools/launch.py) or the cluster env configured a coordinator
-        if kv_type == "dist_async" or kv_type == "dist_device_async":
-            logging.warning(
-                "kvstore %r: async consistency is not supported on this "
-                "backend (no parameter-server process); running with "
-                "bulk-synchronous semantics — every worker must push "
-                "each key the same number of times.", kv_type)
+        self._async = kv_type in ("dist_async", "dist_device_async")
+        self._ps_server = None
+        self._ps = None
         coord = os.environ.get("MXNET_COORDINATOR")
         kwargs = {}
         if coord:
@@ -225,6 +226,69 @@ class DistKVStore(TPUKVStore):
                         "single configured process — proceeding locally.",
                         kv_type, exc)
         self._start_heartbeat()
+        if self._async:
+            self._start_parameter_server()
+
+    # -- async parameter server (reference: kvstore_dist_server.h) -----
+    def _start_parameter_server(self):
+        """'dist_async': rank 0 hosts a ParameterServer thread applying
+        pushes on arrival (update-on-arrival consistency, the reference
+        async branch kvstore_dist_server.h:199-207); every rank holds a
+        PSClient.  Single-process creation keeps the local in-memory
+        semantics (no server) so unit tests/tools work unlaunched."""
+        import jax
+
+        if jax.process_count() == 1:
+            self._async = False  # local: async == sync semantics
+            return
+        import numpy as _np
+        from jax.experimental import multihost_utils
+
+        from .ps import ParameterServer, PSClient
+
+        # rank 0 binds an ephemeral port and announces its own
+        # reachable (host, port) — the coordinator may live on a
+        # different machine, so the server's address must come from
+        # rank 0 itself
+        port = 0
+        host_b = b""
+        if self.rank == 0:
+            import socket as _socket
+
+            self._ps_server = ParameterServer()
+            port = self._ps_server.port
+            try:
+                host_b = _socket.gethostbyname(
+                    _socket.gethostname()).encode()
+            except OSError:
+                host_b = b"127.0.0.1"
+        msg = _np.zeros(65, _np.int32)
+        msg[0] = port
+        msg[1:1 + len(host_b)] = _np.frombuffer(host_b, _np.uint8)
+        msg = multihost_utils.broadcast_one_to_all(msg)
+        port = int(msg[0])
+        host = bytes(msg[1:][msg[1:] > 0].astype(_np.uint8)).decode()
+        self._ps = PSClient(host or "127.0.0.1", port)
+
+    def init(self, key, value):
+        if self._ps is not None:
+            keys, values = _key_value(key, value)
+            for k, v in zip(keys, values):
+                arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+                self._ps.init(k, arr)  # first worker's init wins
+            return
+        super().init(key, value)
+
+    def set_optimizer(self, optimizer):
+        if self._ps is not None:
+            # the optimizer runs ON the server (reference: pickled and
+            # sent via send_command_to_servers, kvstore.py:232); local
+            # updater stays None so save_optimizer_states refuses like
+            # the reference's dist stores
+            self._optimizer = optimizer
+            self._ps.set_optimizer(optimizer)
+            return
+        super().set_optimizer(optimizer)
 
     # -- cross-process aggregation -------------------------------------
     def push(self, key, value, priority=0):
@@ -244,6 +308,15 @@ class DistKVStore(TPUKVStore):
         """
         import jax
 
+        if self._ps is not None:
+            # async: each push is applied by the server the moment it
+            # arrives — no cross-worker rendezvous of any kind
+            keys, values = _key_value_lists(key, value)
+            for k, vlist in zip(keys, values):
+                merged = vlist[0]._data if len(vlist) == 1 else _tree_sum(
+                    tuple(v._data for v in vlist))
+                self._ps.push(k, np.asarray(merged))
+            return
         if jax.process_count() == 1:
             return super().push(key, value, priority)
         from jax.experimental import multihost_utils
@@ -261,6 +334,17 @@ class DistKVStore(TPUKVStore):
                 self._updater(k, NDArray(merged), stored)
             else:
                 stored._set_data(merged.astype(stored.dtype))
+
+    def pull(self, key, out=None, priority=0):
+        if self._ps is not None:
+            assert out is not None
+            keys, outs = _key_value_lists(key, out)
+            for k, olist in zip(keys, outs):
+                cur = self._ps.pull(k)  # current weights, no barrier
+                for o in olist:
+                    o._set_data(jnp.asarray(cur).astype(o.dtype))
+            return
+        super().pull(key, out=out, priority=priority)
 
     # -- heartbeat-based failure detection -----------------------------
     def _start_heartbeat(self):

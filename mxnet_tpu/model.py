@@ -47,10 +47,13 @@ def _create_kvstore(kvstore, num_device, arg_params):
                     update_on_kvstore = False
     else:
         raise TypeError("kvstore must be KVStore, str or None")
-    if kv is not None and kv.type.startswith(("tpu", "dist")):
-        # mesh kvstores: the optimizer update runs inside the fused
+    if kv is not None and kv.type.startswith("tpu"):
+        # mesh kvstore: the optimizer update runs inside the fused
         # program (the sharded-update analogue of update_on_kvstore)
         update_on_kvstore = False
+    # dist_* keeps update_on_kvstore=True (reference rule, model.py:64:
+    # the optimizer runs store-side — here a replicated updater fed by
+    # the cross-process allgather-sum, or the async parameter server)
     if kv is None:
         update_on_kvstore = False
     return kv, update_on_kvstore

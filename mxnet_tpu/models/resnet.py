@@ -57,9 +57,53 @@ def residual_unit(data, num_filter, stride, dim_match, name, bottle_neck=True,
     return conv2 + shortcut
 
 
+def _s2d_stem(data, nchannel, height, width, num_filter):
+    """Space-to-depth stem: the 7x7/stride-2 conv re-expressed as a 4x4/
+    stride-1 conv on a 2x2 space-to-depth input.
+
+    TPU rationale: conv0 has C_in=3, which occupies 3 of the MXU's 128
+    lanes — its forward, and especially its data-grad (needed for
+    bn_data's beta gradient) and weight-grad, run at <10% MXU
+    efficiency and dominate the stem's step time.  With 2x2
+    space-to-depth the conv sees C_in=12 and half the spatial extent,
+    the standard TPU transform for this layer (cf. the public MLPerf
+    ResNet TPU submissions).  The function class strictly contains the
+    7x7 conv: embedding W7[o,c,ky,kx] at W4[o, 4*c+2*(ky%2)+kx%2,
+    ky//2, kx//2] (see `conv7_to_s2d_weight`) reproduces the reference
+    stem EXACTLY — verified in tests/test_module.py.
+
+    Padding: the 7x7 conv pads 3; padding the image before the s2d
+    reshape (224 -> 230 -> blocks of 2 -> 115) makes every 7x7/s2
+    window land on exactly 4 consecutive blocks, so the 4x4 conv needs
+    no further padding and the equivalence is exact.
+    """
+    body = sym.space_to_depth(data, block_size=2, pad=(3, 3),
+                              channel_order="group_major", name="s2d")
+    return sym.Convolution(body, num_filter=num_filter, kernel=(4, 4),
+                           stride=(1, 1), pad=(0, 0), no_bias=True,
+                           name="conv0")
+
+
+def conv7_to_s2d_weight(w7):
+    """Embed a (O, C, 7, 7) conv0 weight into the (O, 4*C, 4, 4) layout
+    of the s2d stem so both stems compute the identical function."""
+    import numpy as np
+    w7 = np.asarray(w7)
+    o, c = w7.shape[:2]
+    w4 = np.zeros((o, 4 * c, 4, 4), dtype=w7.dtype)
+    ch = np.arange(c) * 4
+    for ky in range(7):
+        for kx in range(7):
+            w4[:, ch + 2 * (ky % 2) + (kx % 2), ky // 2, kx // 2] = \
+                w7[:, :, ky, kx]
+    return w4
+
+
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True, bn_mom=0.9, workspace=256):
-    """reference: symbol_resnet.py resnet"""
+           bottle_neck=True, bn_mom=0.9, workspace=256, stem="conv7"):
+    """reference: symbol_resnet.py resnet; `stem` is a TPU extension:
+    "conv7" (reference-exact) or "s2d" (space-to-depth stem, an exact
+    reparametrization of conv0 — see _s2d_stem)."""
     num_unit = len(units)
     assert num_unit == num_stages
     data = sym.Variable(name="data")
@@ -70,8 +114,12 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
         body = sym.Convolution(data, num_filter=filter_list[0], kernel=(3, 3),
                                stride=(1, 1), pad=(1, 1), no_bias=True, name="conv0")
     else:  # imagenet
-        body = sym.Convolution(data, num_filter=filter_list[0], kernel=(7, 7),
-                               stride=(2, 2), pad=(3, 3), no_bias=True, name="conv0")
+        if stem == "s2d":
+            body = _s2d_stem(data, nchannel, height, width, filter_list[0])
+        else:
+            body = sym.Convolution(data, num_filter=filter_list[0], kernel=(7, 7),
+                                   stride=(2, 2), pad=(3, 3), no_bias=True,
+                                   name="conv0")
         body = sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
                              name="bn0")
         body = sym.Activation(body, act_type="relu", name="relu0")
@@ -100,7 +148,7 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
-               conv_workspace=256, **kwargs):
+               conv_workspace=256, stem="conv7", **kwargs):
     """reference: symbol_resnet.py get_symbol; num_layers ∈
     {18, 34, 50, 101, 152, 200, 269} for imagenet shapes."""
     if isinstance(image_shape, str):
@@ -138,4 +186,4 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
 
     return resnet(units=units, num_stages=num_stages, filter_list=filter_list,
                   num_classes=num_classes, image_shape=image_shape,
-                  bottle_neck=bottle_neck, workspace=conv_workspace)
+                  bottle_neck=bottle_neck, workspace=conv_workspace, stem=stem)

@@ -394,8 +394,11 @@ class Module(BaseModule):
 
         # kvstore='tpu': data parallelism over the whole visible mesh
         # (or the context list), gradients reduced by XLA collectives
-        # inside the fused program — SURVEY §5.8 mapping
-        if kvstore is not None and kvstore.type.startswith(("tpu", "dist")) \
+        # inside the fused program — SURVEY §5.8 mapping.  dist_* does
+        # NOT build a mesh: each process runs its own local program and
+        # the kvstore aggregates over DCN (update_on_kvstore, the
+        # reference architecture).
+        if kvstore is not None and kvstore.type.startswith("tpu") \
                 and self._mesh_plan is None:
             from ..parallel import make_plan
 
@@ -551,8 +554,7 @@ class Module(BaseModule):
                 and not self.inputs_need_grad
                 and not self._update_on_kvstore
                 and (self._kvstore is None
-                     or self._kvstore.type in ("tpu", "local", "device")
-                     or self._kvstore.type.startswith("dist"))
+                     or self._kvstore.type in ("tpu", "local", "device"))
                 and self._optimizer is not None
                 and hasattr(self._optimizer, "apply")
                 and self._exec._outputs_all_loss_heads())

@@ -1,0 +1,211 @@
+"""Asynchronous parameter server — the 'dist_async' backend.
+
+The reference's async mode runs an updater on a server process and
+applies every worker push the moment it arrives, with pulls returning
+whatever the weights currently are — no cross-worker barrier
+(``src/kvstore/kvstore_dist_server.h:199-207``: ``if (async_) {
+exec_.Exec([this, key, merged]() { updater_(key, merged, &stored); })
+}``).  ps-lite carried the bytes.
+
+Here the server is a thread on rank 0 speaking a length-prefixed
+pickle protocol over TCP (the DCN path); workers connect lazily and
+each request is served under a per-server lock, so updates are applied
+in arrival order — stragglers never stall fast workers, which is the
+consistency/throughput trade the reference's async mode makes.
+
+The server port is chosen ephemerally by rank 0 and announced to the
+other processes with ``multihost_utils.broadcast_one_to_all`` over the
+already-initialized JAX distributed runtime.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["ParameterServer", "PSClient"]
+
+_HDR = struct.Struct("!I")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = _recv_exact(sock, _HDR.size)
+    (n,) = _HDR.unpack(hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+class ParameterServer:
+    """Rank-0 server: stores weights, applies pushes on arrival."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._store: Dict[Any, np.ndarray] = {}
+        # per-key count of applied pushes — doubles as the version
+        # returned by pull (each applied push is one version bump)
+        self._applied: Dict[Any, int] = {}
+        self._updater = None
+        self._lock = threading.Lock()
+        server_self = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        req = _recv_msg(self.request)
+                        _send_msg(self.request, server_self._dispatch(req))
+                except (ConnectionError, EOFError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="mxnet_tpu-ps")
+        self._thread.start()
+
+    # -- request dispatch (all under the store lock: arrival order) ----
+    def _dispatch(self, req):
+        op = req[0]
+        try:
+            with self._lock:
+                if op == "init":
+                    _, key, value = req
+                    # first init wins; later inits are no-ops (every
+                    # worker calls init — reference server keeps the
+                    # first arrival's value)
+                    if key not in self._store:
+                        self._store[key] = np.array(value, copy=True)
+                        self._applied[key] = 0
+                    return ("ok",)
+                if op == "push":
+                    _, key, grad = req
+                    if key not in self._store:
+                        raise MXNetError(f"push to uninitialized key {key}")
+                    stored = self._store[key]
+                    if self._updater is not None:
+                        # update-on-arrival: exactly the reference async
+                        # branch (kvstore_dist_server.h:199-207)
+                        from .ndarray import NDArray
+                        import jax.numpy as jnp
+
+                        w = NDArray(jnp.asarray(stored))
+                        self._updater(key, NDArray(jnp.asarray(grad)), w)
+                        self._store[key] = np.asarray(w.asnumpy(),
+                                                      dtype=stored.dtype)
+                    else:
+                        self._store[key] = np.asarray(grad,
+                                                      dtype=stored.dtype)
+                    self._applied[key] += 1
+                    return ("ok",)
+                if op == "pull":
+                    _, key = req
+                    if key not in self._store:
+                        raise MXNetError(f"pull from uninitialized key {key}")
+                    return ("ok", self._store[key], self._applied[key])
+                if op == "set_optimizer":
+                    _, blob = req
+                    from . import optimizer as opt
+
+                    # first installation wins: every rank's Module calls
+                    # set_optimizer; replacing a live updater would
+                    # silently reset momentum/lr-schedule state for
+                    # pushes already applied
+                    if self._updater is None:
+                        self._updater = opt.get_updater(pickle.loads(blob))
+                    return ("ok",)
+                if op == "num_applied":
+                    _, key = req
+                    return ("ok", self._applied.get(key, 0))
+                if op == "stop":
+                    threading.Thread(target=self._server.shutdown,
+                                     daemon=True).start()
+                    return ("ok",)
+            raise MXNetError(f"unknown ps op {op!r}")
+        except MXNetError as e:
+            return ("err", str(e))
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class PSClient:
+    """One persistent connection per process (thread-safe)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self._addr = (host, port)
+        self._lock = threading.Lock()
+        deadline = timeout
+        import time
+
+        t0 = time.time()
+        while True:
+            try:
+                self._sock = socket.create_connection(self._addr, timeout=10)
+                break
+            except OSError:
+                if time.time() - t0 > deadline:
+                    raise MXNetError(
+                        f"cannot reach parameter server at {self._addr}")
+                time.sleep(0.2)
+
+    def _call(self, *req):
+        with self._lock:
+            _send_msg(self._sock, req)
+            resp = _recv_msg(self._sock)
+        if resp[0] == "err":
+            raise MXNetError(f"parameter server: {resp[1]}")
+        return resp
+
+    def init(self, key, value: np.ndarray):
+        self._call("init", key, np.asarray(value))
+
+    def push(self, key, grad: np.ndarray):
+        self._call("push", key, np.asarray(grad))
+
+    def pull(self, key) -> np.ndarray:
+        return self._call("pull", key)[1]
+
+    def set_optimizer(self, optimizer):
+        self._call("set_optimizer", pickle.dumps(optimizer))
+
+    def num_applied(self, key) -> int:
+        return self._call("num_applied", key)[1]
+
+    def stop(self):
+        try:
+            self._call("stop")
+        except Exception:
+            pass
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,73 @@
+"""Worker for the dist_async update-on-arrival proof.
+
+Launched by ``tools/launch.py -n 2 --cpu python
+tests/dist_async_worker.py``.  Workers push at DIFFERENT rates with no
+barrier between pushes (reference semantics:
+``kvstore_dist_server.h:199-207`` — the server applies each push the
+moment it arrives; pulls return whatever the weights currently are).
+The final weight must reflect every push exactly once:
+w = -lr * total_pushes for SGD on all-ones gradients.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 4)
+LR = 0.5
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, nw = kv.rank, kv.num_workers
+    pushes = 5 * (rank + 1)  # deliberately unequal
+    total = sum(5 * (r + 1) for r in range(nw))
+
+    kv.init("w", mx.nd.zeros(SHAPE))
+    if rank == 0:
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=LR, rescale_grad=1.0,
+                                          wd=0.0, momentum=0.0))
+    kv.barrier()  # optimizer installed before anyone pushes
+
+    seen = []
+    for i in range(pushes):
+        kv.push("w", mx.nd.ones(SHAPE))
+        # interleaved pulls must return CURRENT (possibly mid-flight)
+        # weights without any rendezvous with the other worker
+        out = mx.nd.zeros(SHAPE)
+        kv.pull("w", out=out)
+        v = out.asnumpy()
+        assert np.isfinite(v).all()
+        assert np.allclose(v, v.flat[0]), "server state must be uniform"
+        seen.append(float(v.flat[0]))
+        time.sleep(0.01 * (rank + 1))  # different worker cadences
+
+    # pulls observed monotonically decreasing weights (each applied
+    # push subtracts lr) — evidence updates landed on arrival, not at
+    # a barrier at the end
+    assert all(b <= a + 1e-6 for a, b in zip(seen, seen[1:])), seen
+    # this worker's own pushes must each have been applied by now: after
+    # our i-th push the weight is at most -lr*(i+1) (other worker only
+    # subtracts more)
+    assert seen[-1] <= -LR * pushes + 1e-5, seen
+
+    kv.barrier()  # end-of-test rendezvous only
+    out = mx.nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, -LR * total),
+                               rtol=1e-6)
+    applied = kv._ps.num_applied("w")
+    assert applied == total, f"server applied {applied} != {total} pushes"
+    kv.barrier()
+    print(f"worker {rank}/{nw}: dist_async update-on-arrival OK "
+          f"({pushes} pushes, {total} applied)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

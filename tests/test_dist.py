@@ -73,3 +73,55 @@ def test_bandwidth_tool_runs():
         capture_output=True, text=True, timeout=300, cwd=REPO, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "psum GB/s" in r.stdout
+
+
+def test_launch_module_fit_dist_sync(tmp_path):
+    """Module.fit across 2 real processes (kvstore='dist_sync',
+    update_on_kvstore) must produce the same final weights as a
+    single-process run on the union data — the reference's
+    tests/nightly/dist_lenet.py check."""
+    import numpy as np
+
+    out = str(tmp_path / "dist_params")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--cpu",
+         sys.executable, os.path.join(REPO, "tests", "dist_module_worker.py"),
+         out],
+        capture_output=True, text=True, timeout=900, cwd=REPO)
+    o = r.stdout + r.stderr
+    assert r.returncode == 0, o
+    assert "worker 0/2: module fit dist_sync OK" in o
+    assert "worker 1/2: module fit dist_sync OK" in o
+
+    # single-process reference: same data, global batch, local updater
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import dist_module_worker as W
+    X, y = W.make_data()
+    single = W.train(X, y, W.GLOBAL_BATCH, kvstore=None)
+
+    d0 = dict(np.load(out + ".rank0.npz"))
+    d1 = dict(np.load(out + ".rank1.npz"))
+    assert set(d0) == set(single)
+    for k in single:
+        # both workers identical (replicated updater)
+        np.testing.assert_allclose(d0[k], d1[k], rtol=1e-6, atol=1e-7,
+                                   err_msg=f"worker disagreement on {k}")
+        # and equal to the single-process run
+        np.testing.assert_allclose(d0[k], single[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=f"dist != single for {k}")
+
+
+def test_launch_two_process_dist_async():
+    """Real async consistency: unequal push rates, pulls without
+    rendezvous, every push applied on arrival (reference:
+    kvstore_dist_server.h:199-207)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--cpu",
+         sys.executable, os.path.join(REPO, "tests", "dist_async_worker.py")],
+        capture_output=True, text=True, timeout=600, cwd=REPO)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out
+    assert "worker 0/2: dist_async update-on-arrival OK" in out
+    assert "worker 1/2: dist_async update-on-arrival OK" in out

@@ -228,3 +228,41 @@ def test_module_param_aliased_to_frozen_buffer_train():
     assert np.all(np.isfinite(out))
     # the frozen param's buffer must still be alive and unchanged shape
     assert mod._exec.arg_dict["dec_weight"].asnumpy().shape == (16, 16)
+
+
+def test_resnet_s2d_stem_equivalence():
+    """The space-to-depth stem with an embedded 7x7 weight computes the
+    identical function to the reference conv7 stem (models/resnet.py
+    _s2d_stem / conv7_to_s2d_weight)."""
+    import importlib
+    R = importlib.import_module("mxnet_tpu.models.resnet")
+
+    rng = np.random.RandomState(0)
+    batch, hw = 2, 64  # >32 so the imagenet stem is selected
+    X = rng.randn(batch, 3, hw, hw).astype(np.float32)
+    outs = {}
+    for stem in ("conv7", "s2d"):
+        sym = R.get_symbol(num_classes=10, num_layers=50,
+                           image_shape=(3, hw, hw), stem=stem)
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[mx.io.DataDesc("data", (batch, 3, hw, hw))],
+                 label_shapes=[mx.io.DataDesc("softmax_label", (batch,))],
+                 for_training=False)
+        mod.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+        if stem == "conv7":
+            arg_params, aux_params = mod.get_params()
+            saved = ({k: v.asnumpy() for k, v in arg_params.items()},
+                     {k: v.asnumpy() for k, v in aux_params.items()})
+        else:
+            args, auxs = saved
+            args = dict(args)
+            args["conv0_weight"] = R.conv7_to_s2d_weight(
+                args["conv0_weight"])
+            mod.set_params({k: mx.nd.array(v) for k, v in args.items()},
+                           {k: mx.nd.array(v) for k, v in auxs.items()})
+        mod.forward(mx.io.DataBatch(
+            [mx.nd.array(X)], [mx.nd.array(np.zeros(batch, np.float32))]),
+            is_train=False)
+        outs[stem] = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(outs["s2d"], outs["conv7"],
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,160 @@
+"""Minimal pure-Python reader for XPlane profiler protobufs.
+
+``jax.profiler.trace`` writes a ``*.xplane.pb`` (an ``XSpace`` proto —
+the public schema from tsl/profiler/protobuf/xplane.proto).  The
+installed tensorboard_plugin_profile's generated protos are
+incompatible with this image's protobuf runtime, so this module decodes
+the wire format directly: protobuf wire encoding is stable and the
+subset needed (planes -> lines -> events + metadata maps) is small.
+
+Field numbers (from the public xplane.proto):
+  XSpace:   planes=1
+  XPlane:   id=1 name=2 lines=3 event_metadata=4(map) stat_metadata=5(map)
+  XLine:    id=1 name=2 timestamp_ns=3 events=4 display_name=11
+  XEvent:   metadata_id=1 offset_ps=2 duration_ps=3 stats=4
+  XEventMetadata: id=1 name=2
+  XStat:    metadata_id=1 double=2 uint64=3 int64=4 str=5 bytes=6 ref=7
+  XStatMetadata:  id=1 name=2
+"""
+
+import struct
+
+
+def _read_varint(buf, i):
+    shift = 0
+    out = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) for every field in buf.
+    Length-delimited values are memoryview slices; varints are ints."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        fn, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fn, wt, v
+
+
+def _parse_metadata_map(buf, name_field=2):
+    """Parse map<int64, X*Metadata> entries -> {id: name}."""
+    out = {}
+    for fn, wt, v in _fields(buf):
+        if fn == 1 and wt == 0:
+            pass
+        elif fn == 2 and wt == 2:
+            mid, name = 0, ""
+            for f2, w2, v2 in _fields(v):
+                if f2 == 1 and w2 == 0:
+                    mid = v2
+                elif f2 == name_field and w2 == 2:
+                    name = bytes(v2).decode("utf-8", "replace")
+            out[mid] = name
+    return out
+
+
+class XStat:
+    __slots__ = ("metadata_id", "value")
+
+    def __init__(self, buf):
+        self.metadata_id = 0
+        self.value = None
+        for fn, wt, v in _fields(buf):
+            if fn == 1 and wt == 0:
+                self.metadata_id = v
+            elif fn == 2 and wt == 1:
+                self.value = struct.unpack("<d", v)[0]
+            elif fn in (3, 4, 7) and wt == 0:
+                self.value = v
+            elif fn in (5, 6) and wt == 2:
+                self.value = bytes(v).decode("utf-8", "replace")
+
+
+class XEvent:
+    __slots__ = ("metadata_id", "offset_ps", "duration_ps", "stats")
+
+    def __init__(self, buf):
+        self.metadata_id = 0
+        self.offset_ps = 0
+        self.duration_ps = 0
+        self.stats = []
+        for fn, wt, v in _fields(buf):
+            if fn == 1 and wt == 0:
+                self.metadata_id = v
+            elif fn == 2 and wt == 0:
+                self.offset_ps = v
+            elif fn == 3 and wt == 0:
+                self.duration_ps = v
+            elif fn == 4 and wt == 2:
+                self.stats.append(XStat(v))
+
+
+class XLine:
+    __slots__ = ("name", "timestamp_ns", "events")
+
+    def __init__(self, buf):
+        self.name = ""
+        self.timestamp_ns = 0
+        self.events = []
+        for fn, wt, v in _fields(buf):
+            if fn == 2 and wt == 2:
+                self.name = bytes(v).decode("utf-8", "replace")
+            elif fn == 3 and wt == 0:
+                self.timestamp_ns = v
+            elif fn == 4 and wt == 2:
+                self.events.append(XEvent(v))
+
+
+class XPlane:
+    __slots__ = ("name", "lines", "event_names", "stat_names")
+
+    def __init__(self, buf):
+        self.name = ""
+        self.lines = []
+        em_bufs, sm_bufs = [], []
+        for fn, wt, v in _fields(buf):
+            if fn == 2 and wt == 2:
+                self.name = bytes(v).decode("utf-8", "replace")
+            elif fn == 3 and wt == 2:
+                self.lines.append(XLine(v))
+            elif fn == 4 and wt == 2:
+                em_bufs.append(v)
+            elif fn == 5 and wt == 2:
+                sm_bufs.append(v)
+        self.event_names = {}
+        self.stat_names = {}
+        for b in em_bufs:
+            self.event_names.update(_parse_metadata_map(b))
+        for b in sm_bufs:
+            self.stat_names.update(_parse_metadata_map(b))
+
+
+def load_xspace(path):
+    """Parse an .xplane.pb file -> list of XPlane."""
+    with open(path, "rb") as f:
+        data = memoryview(f.read())
+    planes = []
+    for fn, wt, v in _fields(data):
+        if fn == 1 and wt == 2:
+            planes.append(XPlane(v))
+    return planes
