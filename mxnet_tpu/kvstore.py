@@ -257,9 +257,21 @@ class DistKVStore(TPUKVStore):
 
             self._ps_server = ParameterServer()
             port = self._ps_server.port
+            # announce the address of the interface that actually
+            # reaches the other workers — gethostbyname(gethostname())
+            # resolves to 127.0.1.1 on stock hosts.  A connected UDP
+            # socket towards the coordinator reveals the outbound
+            # interface without sending a packet.
+            coord_env = __import__("os").environ.get(
+                "MXNET_COORDINATOR", "")
             try:
-                host_b = _socket.gethostbyname(
-                    _socket.gethostname()).encode()
+                chost = coord_env.rsplit(":", 1)[0] or "8.8.8.8"
+                probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+                try:
+                    probe.connect((chost, 1))
+                    host_b = probe.getsockname()[0].encode()
+                finally:
+                    probe.close()
             except OSError:
                 host_b = b"127.0.0.1"
         msg = _np.zeros(65, _np.int32)

@@ -10,6 +10,8 @@ never materializes the full (T, T) score matrix — the building block
 
 from __future__ import annotations
 
+import functools as _functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -27,7 +29,26 @@ def blockwise_attention_partial(q, k, v, causal=False, block_size=512,
     ``kv_offset`` is the absolute position of k[0] minus the absolute
     position of q[0] (the ring rotation uses it for causal masking
     across shards).  Memory: O(Tq · block) instead of O(Tq·Tk).
+
+    On TPU the forward runs as the hand-written Pallas flash kernel
+    (pallas_kernels.flash_attention_partial: MXU score tiles, VMEM-
+    resident online-softmax state); backward rematerializes through
+    this lax.scan formulation.  MXNET_PALLAS=0 disables.
     """
+    from . import pallas_kernels as pk
+
+    if pk.enabled() and q.ndim == 4:
+        koff = jnp.asarray(kv_offset, jnp.int32)
+        return _flash_partial_fn(bool(causal), int(block_size))(
+            q, k, v, koff)
+    return _blockwise_attention_partial_lax(q, k, v, causal, block_size,
+                                            kv_offset)
+
+
+def _blockwise_attention_partial_lax(q, k, v, causal, block_size,
+                                     kv_offset):
+    """The pure lax.scan formulation — reference semantics and the
+    remat backward for the Pallas forward."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
@@ -67,6 +88,36 @@ def blockwise_attention_partial(q, k, v, causal=False, block_size=512,
         body, (o0, m0, l0),
         (kb.swapaxes(0, 1), vb.swapaxes(0, 1), jnp.arange(nblocks)))
     return o, m, l
+
+
+@_functools.lru_cache(maxsize=None)
+def _flash_partial_fn(causal, block_size):
+    """custom_vjp wrapper per (causal, block_size): Pallas forward,
+    lax.scan-remat backward (the LSTM kernel's differentiation
+    pattern).  kv_offset rides along as a non-differentiable int32
+    scalar (it is traced inside the ring's scan)."""
+    import numpy as _np
+
+    from . import pallas_kernels as pk
+
+    @jax.custom_vjp
+    def f(q, k, v, koff):
+        return pk.flash_attention_partial(q, k, v, causal, block_size,
+                                          koff)
+
+    def fwd(q, k, v, koff):
+        return f(q, k, v, koff), (q, k, v, koff)
+
+    def bwd(res, cots):
+        q, k, v, koff = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _blockwise_attention_partial_lax(
+                q_, k_, v_, causal, block_size, koff), q, k, v)
+        dq, dk, dv = vjp(tuple(cots))
+        return dq, dk, dv, _np.zeros(_np.shape(koff), jax.dtypes.float0)
+
+    f.defvjp(fwd, bwd)
+    return f
 
 
 def normalize_attention_state(o, m, l, dtype):

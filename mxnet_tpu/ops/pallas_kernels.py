@@ -213,3 +213,153 @@ def nms(rows, nms_threshold, force_suppress):
         out_shape=jax.ShapeDtypeStruct((B, A, 6), rows.dtype),
         interpret=_interpret(),
     )(rows)
+
+
+# ---------------------------------------------------------------------------
+# Flash attention (blockwise online-softmax partial state)
+# ---------------------------------------------------------------------------
+#
+# The kernel behind ``ops.attention.blockwise_attention_partial`` on
+# TPU: q/k/v tiles live in VMEM, scores for one (q-block, k-block)
+# tile run on the MXU, and the online-softmax state (o, m, l) is
+# accumulated IN the revisited output block across the sequential
+# k-block grid dimension — the (Tq, Tk) score matrix never exists in
+# HBM.  Returns the UN-normalized partial state so ring attention
+# (mxnet_tpu.sequence) can merge per-hop states exactly as with the
+# lax.scan formulation.  ``kv_offset`` is a dynamic scalar (the ring
+# rotates shards, so each hop's key offset is traced) — delivered via
+# scalar prefetch.
+
+
+def _flash_kernel(koff_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, *,
+                  causal, block_q, block_k, tk_valid, scale):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal tile skip: a k-block whose first key position is beyond
+    # this q-block's last query contributes nothing — skip its matmuls
+    # entirely (half the tiles for koff=0 causal attention)
+    if causal:
+        run = (kj * block_k + koff_ref[0]) <= (qi * block_q + block_q - 1)
+    else:
+        run = kj >= 0  # always
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]  # (bq, D)
+        k = k_ref[0]  # (bk, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_local = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_local < tk_valid  # Tk padding
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid &= (k_local + koff_ref[0]) <= q_pos
+        s = jnp.where(valid, s, -jnp.inf)
+
+        # m/l blocks are (bq, 128): the scalar-per-row state broadcast
+        # over the lane dim (the canonical TPU layout for row
+        # statistics — a (1, bq) block would put bq in the lane slot
+        # and the leading 1 in the sublane slot, which Mosaic rejects)
+        m_prev = m_ref[0, :, 0]  # (bq,)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        m_safe = jnp.where(m_new == -jnp.inf, 0.0, m_new)
+        p = jnp.where(valid, jnp.exp(s - m_safe[:, None]), 0.0)
+        alpha = jnp.where(m_prev == -jnp.inf, 0.0, jnp.exp(m_prev - m_safe))
+        l_new = l_ref[0, :, 0] * alpha + jnp.sum(p, axis=1)
+        l_ref[0] = jnp.broadcast_to(l_new[:, None], l_ref.shape[1:])
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        o_ref[0] = o_ref[0] * alpha[:, None] + pv
+        m_ref[0] = jnp.broadcast_to(m_new[:, None], m_ref.shape[1:])
+
+
+def _sds(shape, vma):
+    if vma:
+        return jax.ShapeDtypeStruct(shape, jnp.float32, vma=vma)
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _pad_to(x, axis, mult):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def flash_attention_partial(q, k, v, causal, block_size, kv_offset):
+    """(B, Tq, H, D) q + (B, Tk, H, D) k/v -> partial state
+    (o (B,H,Tq,D) f32, m (B,H,Tq) f32, l (B,H,Tq) f32), matching
+    ops.attention.blockwise_attention_partial exactly."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / float(D) ** 0.5
+    # q-block rows land in the LAST dim of the (1, bq) m/l blocks, so
+    # bq must be a multiple of 128 lanes; k-blocks likewise
+    bq = max(128, min(512, (int(block_size) // 128) * 128 or 128))
+    bk = max(128, min(512, (int(block_size) // 128) * 128 or 128))
+
+    # (B, T, H, D) -> (B*H, T, D); pad T to block multiples, D to lanes
+    def _flat(x, t):
+        # jnp functions, not methods: under shard_map+vjp the operands
+        # can be vma-typed wrappers without ndarray methods
+        return jnp.reshape(jnp.transpose(x, (0, 2, 1, 3)), (B * H, t, D))
+
+    qf = _pad_to(_pad_to(_flat(q, Tq), 1, bq), 2, 128)
+    kf = _pad_to(_pad_to(_flat(k, Tk), 1, bk), 2, 128)
+    vf = _pad_to(_pad_to(_flat(v, Tk), 1, bk), 2, 128)
+    Dp = qf.shape[2]
+    Tqp, Tkp = qf.shape[1], kf.shape[1]
+    # under shard_map (ring attention) the outputs vary over the same
+    # mesh axes as the inputs; pallas_call needs that declared
+    try:
+        vma = (jax.typeof(qf).vma | jax.typeof(kf).vma
+               | jax.typeof(vf).vma)
+    except Exception:
+        vma = frozenset()
+    grid = (B * H, Tqp // bq, Tkp // bk)
+    kern = functools.partial(_flash_kernel, causal=causal, block_q=bq,
+                             block_k=bk, tk_valid=Tk, scale=scale)
+    koff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            _vmem_spec((1, bq, Dp), lambda bh, qi, kj, koff: (bh, qi, 0)),
+            _vmem_spec((1, bk, Dp), lambda bh, qi, kj, koff: (bh, kj, 0)),
+            _vmem_spec((1, bk, Dp), lambda bh, qi, kj, koff: (bh, kj, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, bq, Dp), lambda bh, qi, kj, koff: (bh, qi, 0)),
+            _vmem_spec((1, bq, 128), lambda bh, qi, kj, koff: (bh, qi, 0)),
+            _vmem_spec((1, bq, 128), lambda bh, qi, kj, koff: (bh, qi, 0)),
+        ],
+    ) if pltpu is not None else None
+    o, m, l = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[_sds((B * H, Tqp, Dp), vma),
+                   _sds((B * H, Tqp, 128), vma),
+                   _sds((B * H, Tqp, 128), vma)],
+        compiler_params=(pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+            if pltpu is not None and not _interpret() else None),
+        interpret=_interpret(),
+    )(koff, qf, kf, vf)
+    o = jnp.reshape(o[:, :Tq, :D], (B, H, Tq, D))
+    m = jnp.reshape(m[:, :Tq, 0], (B, H, Tq))
+    l = jnp.reshape(l[:, :Tq, 0], (B, H, Tq))
+    return o, m, l

@@ -147,8 +147,12 @@ class ParameterServer:
                                      daemon=True).start()
                     return ("ok",)
             raise MXNetError(f"unknown ps op {op!r}")
-        except MXNetError as e:
-            return ("err", str(e))
+        except Exception as e:  # noqa: BLE001 — ANY server-side failure
+            # must travel back to the pushing worker as ('err', ...);
+            # letting e.g. a shape-mismatch ValueError escape would kill
+            # the handler thread silently and the worker would only see
+            # an unexplained ConnectionError
+            return ("err", f"{type(e).__name__}: {e}")
 
     def close(self):
         self._server.shutdown()
@@ -168,6 +172,11 @@ class PSClient:
         while True:
             try:
                 self._sock = socket.create_connection(self._addr, timeout=10)
+                # the 10s timeout is for CONNECTING only; the reused
+                # stream must block indefinitely — the server serializes
+                # requests under one lock, and a slow response hitting a
+                # recv timeout would desync the length-prefixed protocol
+                self._sock.settimeout(None)
                 break
             except OSError:
                 if time.time() - t0 > deadline:

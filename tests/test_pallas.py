@@ -5,6 +5,8 @@ import os
 import subprocess
 import sys
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -79,6 +81,8 @@ def test_rnn_op_uses_pallas_same_result():
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 sys.path.insert(0, %r)
+import jax
+import jax.numpy as jnp
 import numpy as np
 import mxnet_tpu as mx
 rng = np.random.RandomState(0)
@@ -155,6 +159,8 @@ def test_multibox_detection_pallas_parity():
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 sys.path.insert(0, %r)
+import jax
+import jax.numpy as jnp
 import numpy as np
 import mxnet_tpu as mx
 rng = np.random.RandomState(3)
@@ -182,3 +188,55 @@ np.save(sys.argv[1], out.asnumpy())
             assert r.returncode == 0, r.stderr
             outs.append(np.load(path))
         np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_kernel_parity(monkeypatch, causal):
+    """The Pallas flash kernel (interpret mode on CPU, native on TPU)
+    matches the lax.scan blockwise formulation — outputs AND the
+    un-normalized partial state used by ring attention, including a
+    nonzero kv_offset (the ring's rotated-shard masking)."""
+    monkeypatch.setenv("MXNET_PALLAS", "1")
+    from mxnet_tpu.ops import attention as A
+
+    rng = np.random.RandomState(0)
+    B, T, H, D = 2, 96, 3, 48
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    for koff in (0, -32):
+        o1, m1, l1 = A._blockwise_attention_partial_lax(
+            q, k, v, causal, 64, koff)
+        o2, m2, l2 = A.blockwise_attention_partial(
+            q, k, v, causal=causal, block_size=64, kv_offset=koff)
+        out1 = A.normalize_attention_state(o1, m1, l1, q.dtype)
+        out2 = A.normalize_attention_state(o2, m2, l2, q.dtype)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(out1),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_kernel_grad(monkeypatch):
+    """custom_vjp backward (remat through lax.scan) equals the pure
+    lax path's gradient."""
+    monkeypatch.setenv("MXNET_PALLAS", "1")
+    from mxnet_tpu.ops import attention as A
+
+    rng = np.random.RandomState(1)
+    B, T, H, D = 1, 64, 2, 32
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+
+    def loss_kernel(q, k, v):
+        return A.blockwise_attention(q, k, v, causal=True,
+                                     block_size=64).sum()
+
+    def loss_lax(q, k, v):
+        o, m, l = A._blockwise_attention_partial_lax(q, k, v, True, 64, 0)
+        return A.normalize_attention_state(o, m, l, q.dtype).sum()
+
+    g1 = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_lax, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)

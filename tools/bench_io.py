@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput: can ImageRecordIter feed the chip?
+
+VERDICT r03 missing #4: the training number (bench.py) uses synthetic
+device-resident batches; this measures the real-data path — a packed
+RecordIO set of JPEG-encoded images decoded + augmented by the
+cv2 thread pool (reference: src/io/iter_image_recordio.cc:29-120, the
+OMP decode loop sized against GPU speed).
+
+Writes one JSON line: ImageRecordIter img/s on 224x224 JPEGs vs the
+training step's img/s, and logs the verdict (feed >= train or the
+bottleneck analysis).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np
+
+
+def log(msg):
+    print(f"[bench_io] {msg}", file=sys.stderr, flush=True)
+
+
+def make_dataset(path, n=1024, hw=256, quality=80):
+    """Pack n synthetic JPEGs (random photos-ish gradients + noise)
+    into a RecordIO file with IRHeader labels."""
+    import cv2
+
+    from mxnet_tpu import recordio
+
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    rng = np.random.RandomState(0)
+    base_y = np.linspace(0, 255, hw, dtype=np.float32)[:, None, None]
+    for i in range(n):
+        img = (base_y * rng.rand()
+               + rng.rand(hw, hw, 3).astype(np.float32) * 128).clip(
+                   0, 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".jpg", img,
+                               [int(cv2.IMWRITE_JPEG_QUALITY), quality])
+        assert ok
+        hdr = recordio.IRHeader(0, float(i % 1000), i, 0)
+        rec.write_idx(i, recordio.pack(hdr, buf.tobytes()))
+    rec.close()
+    sz = os.path.getsize(path + ".rec") / 1e6
+    log(f"packed {n} jpegs ({hw}x{hw} q{quality}) -> {sz:.1f} MB")
+
+
+def bench_iter(path, batch_size=128, threads=None, epochs=3):
+    import mxnet_tpu as mx
+
+    threads = threads or int(os.environ.get("BENCH_IO_THREADS",
+                                            str(os.cpu_count() or 4)))
+    it = mx.io.ImageRecordIter(
+        path_imgrec=path + ".rec", path_imgidx=path + ".idx",
+        data_shape=(3, 224, 224), batch_size=batch_size,
+        rand_crop=True, rand_mirror=True, shuffle=True,
+        preprocess_threads=threads)
+    # warm epoch (file cache, thread pool spin-up)
+    n = 0
+    for b in it:
+        n += b.data[0].shape[0]
+    rates = []
+    for _ in range(epochs):
+        it.reset()
+        t0 = time.time()
+        m = 0
+        for b in it:
+            m += b.data[0].shape[0]
+        rates.append(m / (time.time() - t0))
+    log(f"ImageRecordIter threads={threads}: "
+        + ", ".join(f"{r:.0f}" for r in rates) + " img/s")
+    return max(rates), threads
+
+
+def bench_stages(path, n=512):
+    """Per-stage single-thread rates: raw record read, JPEG decode,
+    decode+augment — attributes the bottleneck."""
+    import cv2
+
+    from mxnet_tpu import recordio as rio
+
+    rec = rio.MXRecordIO(path + ".rec", "r")
+    payloads = []
+    for _ in range(n):
+        payloads.append(rec.read())
+    rec.close()
+
+    t0 = time.time()
+    rec = rio.MXRecordIO(path + ".rec", "r")
+    for _ in range(n):
+        rec.read()
+    rec.close()
+    read_rate = n / (time.time() - t0)
+
+    t0 = time.time()
+    for p in payloads:
+        rio.unpack_img(p)
+    decode_rate = n / (time.time() - t0)
+
+    from mxnet_tpu.image import RandomCropAug, HorizontalFlipAug
+    import random as _pyrandom
+
+    augs = [RandomCropAug((224, 224)), HorizontalFlipAug(0.5)]
+    rng = _pyrandom.Random(0)
+    t0 = time.time()
+    for p in payloads:
+        _, img = rio.unpack_img(p)
+        for a in augs:
+            img = a(img, rng)
+        np.ascontiguousarray(np.asarray(img, np.float32).transpose(2, 0, 1))
+    full_rate = n / (time.time() - t0)
+    log(f"stage rates (1 thread): read {read_rate:.0f}, "
+        f"jpeg-decode {decode_rate:.0f}, decode+augment+layout "
+        f"{full_rate:.0f} img/s")
+    return {"read": round(read_rate, 1), "jpeg_decode": round(decode_rate, 1),
+            "decode_augment_layout": round(full_rate, 1)}
+
+
+def main():
+    train_rate = float(os.environ.get("BENCH_TRAIN_RATE", "2605"))
+    ncpu = os.cpu_count() or 1
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "bench")
+        make_dataset(path)
+        stages = bench_stages(path)
+        best, threads = bench_iter(path)
+        sweep = {}
+        for t in (2, 4, 8):
+            if t != threads:
+                r, _ = bench_iter(path, threads=t, epochs=2)
+                sweep[t] = round(r, 1)
+        sweep[threads] = round(best, 1)
+    feed_ok = best >= train_rate
+    cores_needed = int(np.ceil(train_rate / max(best, 1.0)))
+    result = {
+        "metric": "image_recordio_feed_rate",
+        "value": round(best, 2),
+        "unit": "img/s",
+        "host_cores": ncpu,
+        "threads": threads,
+        "thread_sweep": sweep,
+        "stage_rates_1thread": stages,
+        "train_rate_img_s": train_rate,
+        "feeds_training": feed_ok,
+        # decode thread-pool scaling is core-bound: per-core rate x
+        # cores is the capacity on a real TPU host (v5e hosts ship
+        # >100 vCPU; this sandbox has os.cpu_count() shown above)
+        "cores_needed_for_train_rate": cores_needed,
+    }
+    log("feed rate %s training rate (%.0f vs %.0f img/s) on %d host core(s);"
+        " ~%d cores would feed the chip"
+        % (">=" if feed_ok else "<", best, train_rate, ncpu, cores_needed))
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

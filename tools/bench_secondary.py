@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Secondary hardware benchmarks (BASELINE.md rows beyond the headline):
+
+1. LSTM language-model training throughput, PTB-scale configuration —
+   BASELINE's second driver metric (samples/sec/chip LSTM-PTB).  The
+   reference publishes no absolute number (BASELINE.md §LSTM/PTB), so
+   the record here is the measured TPU number + a falling-perplexity
+   canary proving the timed program really trains.
+   Config parity: example/rnn/lstm_bucketing.py defaults — 2-layer
+   LSTM, hidden 200, embed 200, vocab 10k, batch 32; fixed T=32 (the
+   largest default bucket) for steady-state timing.
+
+2. ResNet-50 inference score, batch 32 — the reference's
+   benchmark_score.py sweep (docs/how_to/perf.md:93-100: 713.17 img/s
+   fp32 on P100).
+
+Writes BENCH_SECONDARY.json and prints one JSON line per metric.
+"""
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import numpy as np
+
+P100_SCORE = 713.17  # fp32 ResNet-50 batch-32 inference, perf.md:93-100
+
+
+def log(msg):
+    print(f"[bench2] {msg}", file=sys.stderr, flush=True)
+
+
+def _ce_ppl(probs, labels):
+    """Perplexity over flattened (N, V) probs with int labels,
+    ignore_label=0 (the PTB padding convention)."""
+    p = np.asarray(probs, np.float32).reshape(-1, probs.shape[-1])
+    lab = np.asarray(labels, np.int64).reshape(-1)
+    mask = lab != 0
+    picked = p[np.arange(len(lab)), lab]
+    nll = -np.log(np.maximum(picked[mask], 1e-12))
+    return float(np.exp(nll.mean()))
+
+
+def bench_lstm(batch=32, seq=32, vocab=10000, hidden=200, embed=200,
+               layers=2, iters=200, sync_iters=20):
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                           name="embed")
+    rnn = mx.sym.RNN(data=mx.sym.transpose(emb, axes=(1, 0, 2)),
+                     parameters=mx.sym.Variable("rnn_parameters"),
+                     state=mx.sym.Variable("rnn_state"),
+                     state_cell=mx.sym.Variable("rnn_state_cell"),
+                     state_size=hidden, num_layers=layers, mode="lstm",
+                     name="rnn")
+    out = mx.sym.Reshape(mx.sym.transpose(rnn, axes=(1, 0, 2)),
+                         shape=(-1, hidden))
+    pred = mx.sym.FullyConnected(out, num_hidden=vocab, name="pred")
+    sm = mx.sym.SoftmaxOutput(pred, mx.sym.Reshape(label, shape=(-1,)),
+                              ignore_label=0, use_ignore=True,
+                              name="softmax")
+
+    ctx = mx.tpu() if mx.context.num_devices() else mx.cpu()
+    # synthetic Markov corpus at PTB dimensions: next token depends on
+    # the current one, so perplexity genuinely falls when the LSTM
+    # learns — the convergence canary
+    rng = np.random.RandomState(0)
+    trans = rng.randint(1, vocab, size=(vocab, 2))
+    n_batches = 4
+    batches, labels_np = [], []
+    for _ in range(n_batches):
+        toks = np.empty((batch, seq + 1), np.int64)
+        toks[:, 0] = rng.randint(1, vocab, size=batch)
+        for t in range(seq):
+            toks[:, t + 1] = trans[toks[:, t], rng.randint(0, 2, size=batch)]
+        X = toks[:, :seq].astype(np.float32)
+        Y = toks[:, 1:].astype(np.float32)
+        batches.append(mx.io.DataBatch([mx.nd.array(X, ctx=ctx)],
+                                       [mx.nd.array(Y, ctx=ctx)]))
+        labels_np.append(Y)
+
+    mod = mx.mod.Module(sm, context=ctx)
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (batch, seq))],
+             label_shapes=[mx.io.DataDesc("softmax_label", (batch, seq))],
+             for_training=True)
+    mx.random.seed(0)
+    zeros = mx.nd.zeros((layers, batch, hidden))
+    mod.init_params(mx.initializer.Uniform(0.08),
+                    arg_params={"rnn_state": zeros,
+                                "rnn_state_cell": zeros.copy()},
+                    allow_missing=True)
+    mod.init_optimizer(kvstore=None, optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+
+    t0 = time.time()
+    for i in range(3):
+        mod.forward_backward(batches[i % n_batches])
+        mod.update()
+    mod.get_outputs()[0].wait_to_read()
+    ppl_first = _ce_ppl(mod.get_outputs()[0].asnumpy(), labels_np[2 % n_batches])
+    log(f"lstm warmup+compile {time.time()-t0:.1f}s ppl_first={ppl_first:.1f}")
+
+    windows = 8
+    per_window = max(iters // windows, 1)
+    window_ms, done = [], 0
+    for _ in range(windows):
+        t0 = time.time()
+        for i in range(per_window):
+            mod.forward_backward(batches[(done + i) % n_batches])
+            mod.update()
+        mod.get_outputs()[0].wait_to_read()
+        window_ms.append((time.time() - t0) / per_window * 1000)
+        done += per_window
+    ppl_last = _ce_ppl(mod.get_outputs()[0].asnumpy(),
+                       labels_np[(done - 1) % n_batches])
+    t0 = time.time()
+    for i in range(sync_iters):
+        mod.forward_backward(batches[i % n_batches])
+        mod.update()
+        mod.get_outputs()[0].wait_to_read()
+    sync_ms = (time.time() - t0) / sync_iters * 1000
+
+    best_ms = min(window_ms)
+    med_ms = float(np.median(window_ms))
+    canary_ok = ppl_last < ppl_first
+    log(f"lstm window ms/step: " + ", ".join(f"{m:.2f}" for m in window_ms))
+    log(f"lstm ppl {ppl_first:.1f} -> {ppl_last:.1f} "
+        f"({'OK' if canary_ok else 'FAILED'})")
+    if not canary_ok:
+        raise SystemExit("lstm perplexity did not fall — refusing to report")
+    return {
+        "metric": "lstm_ptb_train_throughput",
+        "value": round(batch * 1000 / best_ms, 2),
+        "unit": "samples/s/chip",
+        "config": {"batch": batch, "seq": seq, "vocab": vocab,
+                   "hidden": hidden, "embed": embed, "layers": layers},
+        "step_ms": round(best_ms, 3),
+        "step_ms_median": round(med_ms, 3),
+        "step_ms_sync": round(sync_ms, 3),
+        "tokens_per_s": round(batch * seq * 1000 / best_ms, 1),
+        "ppl_first": round(ppl_first, 2),
+        "ppl_last": round(ppl_last, 2),
+    }
+
+
+def bench_inference(batch=32, iters=100):
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    precision = os.environ.get("BENCH_PRECISION", "bf16")
+    import jax.numpy as jnp
+
+    dt = jnp.bfloat16 if precision == "bf16" else np.float32
+    sym = models.resnet(num_classes=1000, num_layers=50,
+                        image_shape=(3, 224, 224),
+                        stem=os.environ.get("BENCH_STEM", "s2d"))
+    ctx = mx.tpu() if mx.context.num_devices() else mx.cpu()
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (batch, 3, 224, 224),
+                                         dtype=dt)],
+             label_shapes=[mx.io.DataDesc("softmax_label", (batch,))],
+             for_training=False)
+    mod.init_params(mx.initializer.Xavier(factor_type="in", magnitude=2.34))
+    rng = np.random.RandomState(0)
+    b = mx.io.DataBatch([mx.nd.array(
+        rng.rand(batch, 3, 224, 224).astype(np.float32).astype(dt),
+        ctx=ctx)], [])
+    t0 = time.time()
+    for _ in range(3):
+        mod.forward(b, is_train=False)
+    mod.get_outputs()[0].wait_to_read()
+    log(f"inference warmup+compile {time.time()-t0:.1f}s")
+    windows, per_window, window_ms = 5, max(iters // 5, 1), []
+    for _ in range(windows):
+        t0 = time.time()
+        for _ in range(per_window):
+            mod.forward(b, is_train=False)
+        mod.get_outputs()[0].wait_to_read()
+        window_ms.append((time.time() - t0) / per_window * 1000)
+    out = mod.get_outputs()[0].asnumpy()
+    assert np.all(np.isfinite(out.astype(np.float32)))
+    best = min(window_ms)
+    log("inference window ms/batch: "
+        + ", ".join(f"{m:.2f}" for m in window_ms))
+    return {
+        "metric": "resnet50_inference_score",
+        "value": round(batch * 1000 / best, 2),
+        "unit": "img/s/chip",
+        "batch": batch,
+        "precision": precision,
+        "vs_baseline": round(batch * 1000 / best / P100_SCORE, 3),
+        "baseline_precision": "fp32",
+        "batch_ms": round(best, 3),
+        "batch_ms_median": round(float(np.median(window_ms)), 3),
+    }
+
+
+def main():
+    results = []
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    results.append(bench_lstm())
+    print(json.dumps(results[-1]), flush=True)
+    results.append(bench_inference())
+    print(json.dumps(results[-1]), flush=True)
+    with open(os.path.join(_REPO, "BENCH_SECONDARY.json"), "w") as f:
+        json.dump({"device": str(jax.devices()[0]), "results": results},
+                  f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -87,8 +87,9 @@ def find_xplane(trace_dir):
 import re
 
 _OP_CLASSES = [
-    ("conv", re.compile(r"^%?(convolution|conv_general)")),
-    ("conv_fusion", re.compile(r"^%?\w*convolution\w*_fusion")),
+    # NOTE: any name containing "convolution" is classified "conv" by
+    # the pre-check in _op_class before this table is consulted
+    ("conv", re.compile(r"^%?conv_general")),
     ("dot", re.compile(r"^%?(dot|gemm)")),
     ("pool_bwd", re.compile(r"^%?select_and_scatter")),
     ("reduce_window", re.compile(r"^%?reduce_window")),

@@ -158,3 +158,41 @@ def load_xspace(path):
         if fn == 1 and wt == 2:
             planes.append(XPlane(v))
     return planes
+
+
+def dominant_module_ms(trace_dir):
+    """Find the newest .xplane.pb under trace_dir and return the
+    dominant XLA executable's (ms_per_execution, n_executions) from the
+    device plane — the shared helper behind bench.py's step_ms_device,
+    tools/device_time.py and tools/profile_step.py."""
+    import glob
+    import os
+
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        return None, 0
+    planes = load_xspace(max(paths, key=os.path.getmtime))
+    dev = None
+    for p in planes:
+        if "/device:TPU" in p.name:
+            dev = p
+            break
+    if dev is None:
+        for p in planes:
+            if "/device:" in p.name and "CUSTOM" not in p.name:
+                dev = p
+                break
+    if dev is None:
+        return None, 0
+    mods = {}
+    for line in dev.lines:
+        if line.name == "XLA Modules":
+            for ev in line.events:
+                nm = dev.event_names.get(ev.metadata_id, "?")
+                tot, cnt = mods.get(nm, (0.0, 0))
+                mods[nm] = (tot + ev.duration_ps / 1e9, cnt + 1)
+    if not mods:
+        return None, 0
+    _, (tot, cnt) = max(mods.items(), key=lambda kv: kv[1][0])
+    return tot / max(cnt, 1), cnt
