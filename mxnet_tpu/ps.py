@@ -172,11 +172,13 @@ class PSClient:
         while True:
             try:
                 self._sock = socket.create_connection(self._addr, timeout=10)
-                # the 10s timeout is for CONNECTING only; the reused
-                # stream must block indefinitely — the server serializes
-                # requests under one lock, and a slow response hitting a
-                # recv timeout would desync the length-prefixed protocol
-                self._sock.settimeout(None)
+                # widen the timeout after connecting: the server
+                # serializes requests under one lock so responses can
+                # queue for a long time, and a short recv timeout would
+                # desync the length-prefixed protocol — but keep a
+                # generous ceiling so a dead rank-0 host surfaces as an
+                # error instead of hanging workers forever
+                self._sock.settimeout(600.0)
                 break
             except OSError:
                 if time.time() - t0 > deadline:

@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .base import MXNetError
+from .ops import pallas_kernels as _pk
 from .ops.attention import (attention_state_init, attention_state_merge,
                             blockwise_attention_partial,
                             normalize_attention_state)
@@ -105,8 +106,9 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
         # the Pallas flash kernel's interpret-mode lowering (CPU tests)
         # mixes sp-varying operands with unvarying grid indices in its
         # block dynamic_slices; vma checking rejects that pairing, so
-        # follow JAX's prescribed workaround
-        check_vma=False)
+        # follow JAX's prescribed workaround — but ONLY on the kernel
+        # path, so the lax path keeps full varying-axis checking
+        check_vma=not _pk.enabled())
     return fn(q, k, v)
 
 
@@ -142,5 +144,5 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
         functools.partial(_ulysses_local, axis_name=axis_name,
                           causal=causal, block_size=block_size),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        check_vma=not _pk.enabled())
     return fn(q, k, v)

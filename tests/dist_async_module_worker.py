@@ -1,0 +1,87 @@
+"""Worker for the dist_async Module.fit proof.
+
+Launched by ``tools/launch.py -n 2 --cpu python
+tests/dist_async_module_worker.py``.  Each worker runs Module.fit with
+``kvstore='dist_async'`` on its own shard at its own pace (worker 1
+sleeps between batches): the server applies updates on arrival, so the
+fast worker never waits.  Both workers must converge on the shared
+model and end with the same weights (final pull after a barrier).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    kv = mx.kv.create("dist_async")
+    rank, nw = kv.rank, kv.num_workers
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(128, 16).astype(np.float32)
+    W = rng.randn(16, 3)
+    y = np.argmax(X @ W, axis=1).astype(np.float32)
+    Xs, ys = X[rank::nw], y[rank::nw]
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mx.random.seed(7)
+    it = mx.io.NDArrayIter(Xs, ys, batch_size=16, shuffle=False,
+                           label_name="softmax_label")
+
+    class SlowIter(mx.io.DataIter):
+        """Worker-1 drip-feeds batches: unequal worker cadence."""
+
+        def __init__(self, inner, delay):
+            super().__init__(inner.batch_size)
+            self._inner, self._delay = inner, delay
+
+        @property
+        def provide_data(self):
+            return self._inner.provide_data
+
+        @property
+        def provide_label(self):
+            return self._inner.provide_label
+
+        def reset(self):
+            self._inner.reset()
+
+        def next(self):
+            if self._delay:
+                time.sleep(self._delay)
+            return self._inner.next()
+
+    metric = mx.metric.Accuracy()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(SlowIter(it, 0.02 * rank), num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "rescale_grad": 1.0 / 16},
+            kvstore=kv, eval_metric=metric,
+            initializer=mx.initializer.Xavier(rnd_type="gaussian"))
+    name, acc = metric.get()
+    assert acc > 0.8, f"rank {rank} final epoch accuracy {acc}"
+
+    kv.barrier()
+    # after the barrier both workers pull identical server weights
+    args, _ = mod.get_params()
+    # pull by the kvstore's integer keys (init order = param order)
+    out = {n: mx.nd.zeros(args[n].shape) for n in args}
+    for idx, n in enumerate(mod._param_names):
+        kv.pull(idx, out=out[n])
+    digest = float(sum(np.abs(out[n].asnumpy()).sum() for n in out))
+    print(f"worker {rank}/{nw}: dist_async Module.fit OK "
+          f"acc={acc:.3f} digest={digest:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

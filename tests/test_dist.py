@@ -15,13 +15,24 @@ import mxnet_tpu as mx
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _worker_env():
+    """Env for launcher-spawned workers: one CPU device per process
+    (the realistic per-process topology).  conftest.py's 8-virtual-
+    device XLA_FLAGS would otherwise be inherited — 16 virtual devices
+    across 2 processes plus the PS handler thread oversubscribe this
+    sandbox's single core to a crawl."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    return env
+
+
 def test_launch_two_process_dist_sync():
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", "2", "--cpu",
          sys.executable, os.path.join(REPO, "tests", "dist_worker.py")],
         capture_output=True, text=True, timeout=600,
-        cwd=REPO)
+        cwd=REPO, env=_worker_env())
     out = r.stdout + r.stderr
     assert r.returncode == 0, out
     assert "worker 0/2: dist_sync kvstore OK" in out
@@ -120,8 +131,30 @@ def test_launch_two_process_dist_async():
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", "2", "--cpu",
          sys.executable, os.path.join(REPO, "tests", "dist_async_worker.py")],
-        capture_output=True, text=True, timeout=600, cwd=REPO)
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+        env=_worker_env())
     out = r.stdout + r.stderr
     assert r.returncode == 0, out
     assert "worker 0/2: dist_async update-on-arrival OK" in out
     assert "worker 1/2: dist_async update-on-arrival OK" in out
+
+
+def test_launch_module_fit_dist_async():
+    """Module.fit over the async parameter server: 2 workers at
+    different cadences, both converge, and after the final barrier both
+    pull identical server weights (digest printed and compared)."""
+    import re
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--cpu",
+         sys.executable,
+         os.path.join(REPO, "tests", "dist_async_module_worker.py")],
+        capture_output=True, text=True, timeout=900, cwd=REPO,
+        env=_worker_env())
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out
+    digests = re.findall(r"dist_async Module\.fit OK acc=[\d.]+ "
+                         r"digest=([\d.]+)", out)
+    assert len(digests) == 2, out
+    assert digests[0] == digests[1], f"worker weight digests differ: {digests}"

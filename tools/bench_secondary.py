@@ -32,6 +32,30 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np
 
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+
+def _device_step_ms(run_step, steps=10):
+    """On-device ms/step from a jax.profiler trace (immune to the
+    sandbox tunnel's dispatch latency, which dominates small steps)."""
+    import shutil
+    import tempfile
+
+    from xplane_parse import dominant_module_ms
+
+    tdir = tempfile.mkdtemp(prefix="bench2_trace_")
+    try:
+        with jax.profiler.trace(tdir):
+            run_step(steps)
+        ms, _ = dominant_module_ms(tdir)
+        return ms
+    except Exception as e:
+        log(f"device-time capture failed ({e!r})")
+        return None
+    finally:
+        shutil.rmtree(tdir, ignore_errors=True)
+
+
 P100_SCORE = 713.17  # fp32 ResNet-50 batch-32 inference, perf.md:93-100
 
 
@@ -131,6 +155,13 @@ def bench_lstm(batch=32, seq=32, vocab=10000, hidden=200, embed=200,
         mod.get_outputs()[0].wait_to_read()
     sync_ms = (time.time() - t0) / sync_iters * 1000
 
+    def run_steps(n):
+        for i in range(n):
+            mod.forward_backward(batches[i % n_batches])
+            mod.update()
+        mod.get_outputs()[0].wait_to_read()
+
+    dev_ms = _device_step_ms(run_steps)
     best_ms = min(window_ms)
     med_ms = float(np.median(window_ms))
     canary_ok = ppl_last < ppl_first
@@ -148,6 +179,9 @@ def bench_lstm(batch=32, seq=32, vocab=10000, hidden=200, embed=200,
         "step_ms": round(best_ms, 3),
         "step_ms_median": round(med_ms, 3),
         "step_ms_sync": round(sync_ms, 3),
+        "step_ms_device": round(dev_ms, 3) if dev_ms else None,
+        "samples_per_s_device": (round(batch * 1000 / dev_ms, 2)
+                                 if dev_ms else None),
         "tokens_per_s": round(batch * seq * 1000 / best_ms, 1),
         "ppl_first": round(ppl_first, 2),
         "ppl_last": round(ppl_last, 2),
@@ -190,9 +224,17 @@ def bench_inference(batch=32, iters=100):
         window_ms.append((time.time() - t0) / per_window * 1000)
     out = mod.get_outputs()[0].asnumpy()
     assert np.all(np.isfinite(out.astype(np.float32)))
+
+    def run_steps(n):
+        for _ in range(n):
+            mod.forward(b, is_train=False)
+        mod.get_outputs()[0].wait_to_read()
+
+    dev_ms = _device_step_ms(run_steps, steps=20)
     best = min(window_ms)
     log("inference window ms/batch: "
-        + ", ".join(f"{m:.2f}" for m in window_ms))
+        + ", ".join(f"{m:.2f}" for m in window_ms)
+        + (f"; device {dev_ms:.3f} ms" if dev_ms else ""))
     return {
         "metric": "resnet50_inference_score",
         "value": round(batch * 1000 / best, 2),
@@ -203,6 +245,99 @@ def bench_inference(batch=32, iters=100):
         "baseline_precision": "fp32",
         "batch_ms": round(best, 3),
         "batch_ms_median": round(float(np.median(window_ms)), 3),
+        "batch_ms_device": round(dev_ms, 3) if dev_ms else None,
+        "img_per_s_device": (round(batch * 1000 / dev_ms, 2)
+                             if dev_ms else None),
+    }
+
+
+def bench_train(network, batch, baseline_img_s, iters=100,
+                image_shape=(3, 224, 224)):
+    """Training throughput for a model-zoo network — the remaining
+    BASELINE.md training rows (perf.md:105-138: Inception-v3 129.98
+    img/s, AlexNet 1869.69 img/s on P100 fp32)."""
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    precision = os.environ.get("BENCH_PRECISION", "bf16")
+    dt = jnp.bfloat16 if precision == "bf16" else np.float32
+    sym = models.get_symbol(network, num_classes=1000,
+                            image_shape=image_shape)
+    ctx = mx.tpu() if mx.context.num_devices() else mx.cpu()
+    rng = np.random.RandomState(0)
+    n_batches = 2
+    batches, labels_np = [], []
+    for _ in range(n_batches):
+        X = mx.nd.array(rng.rand(batch, *image_shape).astype(np.float32)
+                        .astype(dt), ctx=ctx)
+        y = rng.randint(0, 1000, size=batch).astype(np.float32)
+        batches.append(mx.io.DataBatch([X], [mx.nd.array(y, ctx=ctx)]))
+        labels_np.append(y)
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (batch,) + image_shape,
+                                         dtype=dt)],
+             label_shapes=[mx.io.DataDesc("softmax_label", (batch,))],
+             for_training=True)
+    mod.init_params(mx.initializer.Xavier(factor_type="in", magnitude=2.34))
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.005,
+                                         "momentum": 0.9})
+    t0 = time.time()
+    for i in range(3):
+        mod.forward_backward(batches[i % n_batches])
+        mod.update()
+    mod.get_outputs()[0].wait_to_read()
+    first = np.asarray(mod.get_outputs()[0].asnumpy(), np.float32)
+    lab = labels_np[2 % n_batches].astype(np.int64)
+    loss_first = float(-np.mean(np.log(np.maximum(
+        first[np.arange(batch), lab], 1e-12))))
+    log(f"{network} warmup+compile {time.time()-t0:.1f}s")
+    windows, per_window, window_ms = 5, max(iters // 5, 1), []
+    done = 0
+    for _ in range(windows):
+        t0 = time.time()
+        for i in range(per_window):
+            mod.forward_backward(batches[(done + i) % n_batches])
+            mod.update()
+        mod.get_outputs()[0].wait_to_read()
+        window_ms.append((time.time() - t0) / per_window * 1000)
+        done += per_window
+    last = np.asarray(mod.get_outputs()[0].asnumpy(), np.float32)
+    lab = labels_np[(done - 1) % n_batches].astype(np.int64)
+    loss_last = float(-np.mean(np.log(np.maximum(
+        last[np.arange(batch), lab], 1e-12))))
+    def run_steps(n):
+        for i in range(n):
+            mod.forward_backward(batches[i % n_batches])
+            mod.update()
+        mod.get_outputs()[0].wait_to_read()
+
+    dev_ms = _device_step_ms(run_steps)
+    best = min(window_ms)
+    canary_ok = loss_last < loss_first
+    log(f"{network} window ms/step: "
+        + ", ".join(f"{m:.2f}" for m in window_ms)
+        + f"; loss {loss_first:.3f}->{loss_last:.3f} "
+        f"({'OK' if canary_ok else 'FAILED'})")
+    if not canary_ok:
+        raise SystemExit(f"{network}: loss did not fall")
+    img_s = batch * 1000 / best
+    return {
+        "metric": f"{network}_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s/chip",
+        "batch": batch,
+        "precision": precision,
+        "vs_baseline": round(img_s / baseline_img_s, 3),
+        "baseline_precision": "fp32",
+        "step_ms": round(best, 3),
+        "step_ms_median": round(float(np.median(window_ms)), 3),
+        "step_ms_device": round(dev_ms, 3) if dev_ms else None,
+        "img_per_s_device": (round(batch * 1000 / dev_ms, 2)
+                             if dev_ms else None),
+        "loss_first": round(loss_first, 4),
+        "loss_last": round(loss_last, 4),
     }
 
 
@@ -212,6 +347,13 @@ def main():
     results.append(bench_lstm())
     print(json.dumps(results[-1]), flush=True)
     results.append(bench_inference())
+    print(json.dumps(results[-1]), flush=True)
+    # remaining BASELINE training rows (P100 fp32, perf.md:105-138);
+    # batch matches the reference's own benchmark configs
+    results.append(bench_train("inception-v3", 64, 129.98,
+                               image_shape=(3, 299, 299)))
+    print(json.dumps(results[-1]), flush=True)
+    results.append(bench_train("alexnet", 256, 1869.69))
     print(json.dumps(results[-1]), flush=True)
     with open(os.path.join(_REPO, "BENCH_SECONDARY.json"), "w") as f:
         json.dump({"device": str(jax.devices()[0]), "results": results},
