@@ -13,9 +13,10 @@ from .vgg import get_symbol as vgg
 from .resnet import get_symbol as resnet
 from .inception_bn import get_symbol as inception_bn
 from .inception_v3 import get_symbol as inception_v3
+from .transformer import get_symbol as transformer_lm
 
 __all__ = ["mlp", "lenet", "alexnet", "vgg", "resnet", "inception_bn",
-           "inception_v3", "get_symbol"]
+           "inception_v3", "transformer_lm", "get_symbol"]
 
 _FACTORY = {
     "mlp": mlp,
@@ -27,6 +28,8 @@ _FACTORY = {
     "inception_bn": inception_bn,
     "inception-v3": inception_v3,
     "inception_v3": inception_v3,
+    "transformer-lm": transformer_lm,
+    "transformer_lm": transformer_lm,
 }
 
 

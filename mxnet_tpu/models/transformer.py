@@ -1,0 +1,99 @@
+"""Decoder-only transformer language model.
+
+The reference (v0.9.1) predates transformers; this model family is the
+framework's long-context flagship, built entirely from registered
+symbol ops: ``DotProductAttention`` (the Pallas flash kernel on TPU,
+``ops/attention.py``), ``LayerNorm``, GELU, and flatten=False
+``FullyConnected``.  Pre-LN residual blocks (the trainable-at-depth
+variant), learned positional embeddings, weight-tied-free output head,
+``SoftmaxOutput(preserve_shape)`` loss over (B, T) token labels.
+
+Sequence parallelism: the same attention primitive is distributed by
+``mxnet_tpu.sequence`` (ring / Ulysses) over an 'sp' mesh axis — see
+``__graft_entry__.dryrun_multichip`` and tests/test_sequence.py; this
+symbol graph is the single-shard program those wrap.
+"""
+
+from .. import symbol as sym
+
+
+def _block(x, d_model, num_heads, d_ff, name, causal, dropout,
+           block_size):
+    head_dim = d_model // num_heads
+    # attention sublayer (pre-LN)
+    h = sym.LayerNorm(x, name=f"{name}_ln1")
+    qkv = sym.FullyConnected(h, num_hidden=3 * d_model, flatten=False,
+                             name=f"{name}_qkv")
+    qkv = sym.Reshape(qkv, shape=(0, 0, 3, num_heads, head_dim),
+                      name=f"{name}_qkv_split")
+    q = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=0, end=1),
+                    shape=(0, 0, -3, 0), name=f"{name}_q")
+    k = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=1, end=2),
+                    shape=(0, 0, -3, 0), name=f"{name}_k")
+    v = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=2, end=3),
+                    shape=(0, 0, -3, 0), name=f"{name}_v")
+    att = sym.DotProductAttention(q, k, v, causal=causal,
+                                  block_size=block_size,
+                                  name=f"{name}_attn")
+    att = sym.Reshape(att, shape=(0, 0, -3), name=f"{name}_attn_merge")
+    att = sym.FullyConnected(att, num_hidden=d_model, flatten=False,
+                             name=f"{name}_proj")
+    if dropout > 0:
+        att = sym.Dropout(att, p=dropout, name=f"{name}_attn_drop")
+    x = x + att
+    # feed-forward sublayer (pre-LN, GELU)
+    h = sym.LayerNorm(x, name=f"{name}_ln2")
+    h = sym.FullyConnected(h, num_hidden=d_ff, flatten=False,
+                           name=f"{name}_ff1")
+    h = sym.Activation(h, act_type="gelu", name=f"{name}_gelu")
+    h = sym.FullyConnected(h, num_hidden=d_model, flatten=False,
+                           name=f"{name}_ff2")
+    if dropout > 0:
+        h = sym.Dropout(h, p=dropout, name=f"{name}_ff_drop")
+    return x + h
+
+
+def transformer_lm(vocab_size, seq_len, num_layers=4, num_heads=4,
+                   d_model=128, d_ff=None, causal=True, dropout=0.0,
+                   block_size=512, dtype="float32"):
+    """Token ids (B, T) -> SoftmaxOutput probabilities (B, T, vocab).
+
+    Labels are next-token ids (B, T); padding id 0 is ignored
+    (ignore_label, like the LSTM LM example).
+
+    ``dtype``: compute dtype of the network.  Token ids stay float32
+    (bf16 cannot represent ids >= 256 exactly — an id rounding past
+    ``vocab_size`` is an out-of-range gather); the cast sits after the
+    embedding so dtype propagation types every downstream layer.  Use
+    "bfloat16" on TPU — beyond the MXU benefit, this backend's f32
+    softmax over 3-D logits lowers ~30x slower than bf16 (PERF.md)."""
+    if d_model % num_heads:
+        raise ValueError(f"d_model {d_model} % num_heads {num_heads} != 0")
+    d_ff = d_ff or 4 * d_model
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    x = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
+                      name="tok_embed")
+    if dtype != "float32":
+        x = sym.Cast(x, dtype=dtype, name="embed_cast")
+    # learned positional embedding: a (T, d) parameter broadcast over
+    # the batch (declared shape so inference doesn't depend on a
+    # position-id input)
+    pos = sym.Variable("pos_embed_weight", shape=(seq_len, d_model),
+                       dtype=dtype, init="[\"zero\", {}]")
+    x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0))
+    for i in range(num_layers):
+        x = _block(x, d_model, num_heads, d_ff, f"layer{i}", causal,
+                   dropout, block_size)
+    x = sym.LayerNorm(x, name="ln_f")
+    logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
+                                name="head")
+    return sym.SoftmaxOutput(logits, label, preserve_shape=True,
+                             ignore_label=0, use_ignore=True,
+                             name="softmax")
+
+
+def get_symbol(vocab_size=10000, seq_len=128, num_layers=4, num_heads=4,
+               d_model=128, **kwargs):
+    return transformer_lm(vocab_size, seq_len, num_layers=num_layers,
+                          num_heads=num_heads, d_model=d_model, **kwargs)

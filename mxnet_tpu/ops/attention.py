@@ -106,14 +106,20 @@ def _flash_partial_fn(causal, block_size):
                                           koff)
 
     def fwd(q, k, v, koff):
-        return f(q, k, v, koff), (q, k, v, koff)
+        o, m, l = f(q, k, v, koff)
+        return (o, m, l), (q, k, v, koff, m)
 
     def bwd(res, cots):
-        q, k, v, koff = res
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: _blockwise_attention_partial_lax(
-                q_, k_, v_, causal, block_size, koff), q, k, v)
-        dq, dk, dv = vjp(tuple(cots))
+        q, k, v, koff, m = res
+        do, dm, dl = cots
+        # Pallas backward (pallas_kernels.flash_attention_bwd): the dm
+        # cotangent is absorbed exactly — every consumer of the partial
+        # state is invariant under (o,m,l) -> (o e^-c, m+c, l e^-c),
+        # which cancels the argmax-subgradient terms (see the kernel's
+        # derivation comment).  Equality with the lax.scan vjp is
+        # asserted in tests/test_pallas.py.
+        dq, dk, dv = pk.flash_attention_bwd(q, k, v, m, do, dl, causal,
+                                            block_size, koff)
         return dq, dk, dv, _np.zeros(_np.shape(koff), jax.dtypes.float0)
 
     f.defvjp(fwd, bwd)

@@ -70,13 +70,21 @@ def _fully_connected(op_ctx, attrs, inputs, aux):
 def _fc_infer(attrs, in_shapes):
     no_bias = attr_bool(attrs.get("no_bias"), False)
     num_hidden = attr_int(attrs.get("num_hidden"))
+    flatten = attr_bool(attrs.get("flatten"), True)
     d = in_shapes[0]
     if d is None:
         return in_shapes, [None], []
-    in_dim = int(np.prod(d[1:]))
+    if flatten or len(d) <= 2:
+        in_dim = int(np.prod(d[1:]))
+        out = (d[0], num_hidden)
+    else:
+        # flatten=False: contract the last dim only, keep leading dims
+        # (reference: fully_connected-inl.h FlattenParam semantics)
+        in_dim = int(d[-1])
+        out = tuple(d[:-1]) + (num_hidden,)
     w = (num_hidden, in_dim)
     ins = [tuple(d), w] if no_bias else [tuple(d), w, (num_hidden,)]
-    return ins, [(d[0], num_hidden)], []
+    return ins, [out], []
 
 
 get_op("FullyConnected").infer_shape = _fc_infer
@@ -103,6 +111,10 @@ def _activation(op_ctx, attrs, inputs, aux):
         return [jax.nn.softplus(x)]
     if act == "softsign":
         return [jax.nn.soft_sign(x)]
+    if act == "gelu":
+        # MXNet 1.x exposes GELU via LeakyReLU(act_type='gelu')
+        # (leaky_relu-inl.h kGELU, erf formulation); accepted here too
+        return [jax.nn.gelu(x, approximate=False)]
     raise MXNetError(f"unknown act_type {act}")
 
 
@@ -125,6 +137,9 @@ def _leaky_relu(op_ctx, attrs, inputs, aux):
     if act == "prelu":
         gamma = inputs[1].reshape((1, -1) + (1,) * (x.ndim - 2))
         return [jnp.where(x > 0, x, gamma * x)]
+    if act == "gelu":
+        # MXNet 1.x kGELU (leaky_relu-inl.h) — erf formulation
+        return [jax.nn.gelu(x, approximate=False)]
     if act == "rrelu":
         lo = attr_float(attrs.get("lower_bound", 0.125))
         hi = attr_float(attrs.get("upper_bound", 0.334))
@@ -486,6 +501,57 @@ def _bn_outs(attrs):
 
 
 get_op("BatchNorm").out_names = _bn_outs
+
+
+@register("LayerNorm", arg_names=("data", "gamma", "beta"),
+          doc="Layer normalization over `axis` (MXNet 1.x layer_norm.cc "
+              "semantics — post-0.9 op, included for the transformer "
+              "model family; single-pass E[x]/E[x^2] statistics like "
+              "BatchNorm above)")
+def _layer_norm(op_ctx, attrs, inputs, aux):
+    x, gamma, beta = inputs
+    axis = attr_int(attrs.get("axis", -1), -1)
+    eps = attr_float(attrs.get("eps", 1e-5), 1e-5)
+    output_mean_var = attr_bool(attrs.get("output_mean_var"), False)
+    ax = axis % x.ndim
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=ax, keepdims=True)
+    mean_sq = jnp.mean(lax.square(xf), axis=ax, keepdims=True)
+    var = jnp.maximum(mean_sq - lax.square(mean), 0.0)
+    inv = lax.rsqrt(var + eps)
+    bshape = [1] * x.ndim
+    bshape[ax] = x.shape[ax]
+    out = (xf - mean) * inv * gamma.reshape(bshape).astype(jnp.float32) \
+        + beta.reshape(bshape).astype(jnp.float32)
+    outs = [out.astype(x.dtype)]
+    if output_mean_var:
+        outs += [jnp.squeeze(mean, ax), jnp.squeeze(lax.rsqrt(var + eps), ax)]
+    return outs
+
+
+def _ln_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    axis = attr_int(attrs.get("axis", -1), -1) % len(d)
+    c = (d[axis],)
+    outs = [tuple(d)]
+    if attr_bool(attrs.get("output_mean_var"), False):
+        red = tuple(s for i, s in enumerate(d) if i != axis)
+        outs += [red, red]
+    return [tuple(d), c, c], outs, []
+
+
+get_op("LayerNorm").infer_shape = _ln_infer
+
+
+def _ln_outs(attrs):
+    if attr_bool(attrs.get("output_mean_var"), False):
+        return ["output", "mean", "std"]
+    return ["output"]
+
+
+get_op("LayerNorm").out_names = _ln_outs
 
 
 @register("InstanceNorm", arg_names=("data", "gamma", "beta"),

@@ -363,3 +363,203 @@ def flash_attention_partial(q, k, v, causal, block_size, kv_offset):
     m = jnp.reshape(m[:, :Tq, 0], (B, H, Tq))
     l = jnp.reshape(l[:, :Tq, 0], (B, H, Tq))
     return o, m, l
+
+
+# -- flash attention backward ----------------------------------------------
+#
+# Gradients of the UN-normalized partial state (o, m, l) wrt q, k, v.
+# Every consumer of the partial state (normalize_attention_state, ring
+# attention_state_merge) is invariant under the rescaling
+# (o, m, l) -> (o e^{-c}, m + c, l e^{-c}), which makes the cotangent
+# identity  m_bar = o_bar·o + l_bar·l  hold, and the argmax-subgradient
+# terms of m cancel EXACTLY.  The backward therefore treats m as a
+# constant:  ds_ij = p_ij * (o_bar_i · v_j + l_bar_i),  with
+# p_ij = exp(q_i·k_j·scale - m_i) under the same masks as forward —
+# verified against the lax.scan vjp in tests/test_pallas.py.
+#
+# Two kernels because the two accumulations need different sequential
+# grid axes: dq accumulates over k-blocks (kj innermost, like the
+# forward), dk/dv accumulate over q-blocks (qi innermost).
+
+
+def _flash_bwd_p(q, k, m, koff, qi, kj, *, causal, block_q, block_k,
+                 tk_valid, scale):
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    k_local = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = k_local < tk_valid
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        valid &= (k_local + koff) <= q_pos
+    m_safe = jnp.where(m == -jnp.inf, 0.0, m)
+    p = jnp.where(valid, jnp.exp(s - m_safe[:, None]), 0.0)
+    return p
+
+
+def _flash_bwd_dq_kernel(koff_ref, q_ref, k_ref, v_ref, m_ref, ob_ref,
+                         lb_ref, dq_ref, *, causal, block_q, block_k,
+                         tk_valid, scale):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    if causal:
+        run = (kj * block_k + koff_ref[0]) <= (qi * block_q + block_q - 1)
+    else:
+        run = kj >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        p = _flash_bwd_p(q, k, m_ref[0, :, 0], koff_ref[0], qi, kj,
+                         causal=causal, block_q=block_q, block_k=block_k,
+                         tk_valid=tk_valid, scale=scale)
+        # ds = p * (o_bar @ v^T + l_bar)
+        ovt = jax.lax.dot_general(ob_ref[0], v, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        ds = p * (ovt + lb_ref[0, :, 0][:, None])
+        dq_ref[0] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+
+def _flash_bwd_dkv_kernel(koff_ref, q_ref, k_ref, v_ref, m_ref, ob_ref,
+                          lb_ref, dk_ref, dv_ref, *, causal, block_q,
+                          block_k, tk_valid, scale):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros_like(dk_ref)
+        dv_ref[...] = jnp.zeros_like(dv_ref)
+
+    if causal:
+        run = (kj * block_k + koff_ref[0]) <= (qi * block_q + block_q - 1)
+    else:
+        run = qi >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        ob = ob_ref[0]
+        p = _flash_bwd_p(q, k, m_ref[0, :, 0], koff_ref[0], qi, kj,
+                         causal=causal, block_q=block_q, block_k=block_k,
+                         tk_valid=tk_valid, scale=scale)
+        pT = p.astype(ob.dtype)
+        dv_ref[0] += jax.lax.dot_general(
+            pT, ob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ovt = jax.lax.dot_general(ob, v, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        ds = p * (ovt + lb_ref[0, :, 0][:, None])
+        dk_ref[0] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+
+def flash_attention_bwd(q, k, v, m, o_bar, l_bar, causal, block_size,
+                        kv_offset):
+    """Gradients (dq, dk, dv) of flash_attention_partial's (o, l)
+    outputs given cotangents o_bar (B,H,Tq,D) and l_bar (B,H,Tq); the
+    m cotangent is absorbed by the rescaling invariance (see above).
+    m is the forward's row-max state (B,H,Tq)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / float(D) ** 0.5
+    bq = max(128, min(512, (int(block_size) // 128) * 128 or 128))
+    bk = bq
+
+    def _flat(x, t):
+        return jnp.reshape(jnp.transpose(x, (0, 2, 1, 3)), (B * H, t, D))
+
+    qf = _pad_to(_pad_to(_flat(q, Tq), 1, bq), 2, 128)
+    kf = _pad_to(_pad_to(_flat(k, Tk), 1, bk), 2, 128)
+    vf = _pad_to(_pad_to(_flat(v, Tk), 1, bk), 2, 128)
+    obf = _pad_to(_pad_to(jnp.reshape(o_bar.astype(jnp.float32),
+                                      (B * H, Tq, D)), 1, bq), 2, 128)
+    # m / l_bar ride as (BH, T, 128) lane-broadcast tensors (the same
+    # layout rule as the forward's m/l outputs)
+    mf = _pad_to(jnp.broadcast_to(
+        jnp.reshape(m, (B * H, Tq))[..., None], (B * H, Tq, 128)), 1, bq)
+    lbf = _pad_to(jnp.broadcast_to(
+        jnp.reshape(l_bar.astype(jnp.float32), (B * H, Tq))[..., None],
+        (B * H, Tq, 128)), 1, bq)
+    # padded q rows: m = -inf there -> p = 0 -> no contribution
+    if mf.shape[1] > Tq:
+        pass
+    Dp, Tqp, Tkp = qf.shape[2], qf.shape[1], kf.shape[1]
+    try:
+        vma = (jax.typeof(qf).vma | jax.typeof(kf).vma | jax.typeof(vf).vma
+               | jax.typeof(obf).vma)
+    except Exception:
+        vma = frozenset()
+    koff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+    kern_kwargs = dict(causal=causal, block_q=bq, block_k=bk,
+                       tk_valid=Tk, scale=scale)
+    cparams = (pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+        if pltpu is not None and not _interpret() else None)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **kern_kwargs),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B * H, Tqp // bq, Tkp // bk),
+            in_specs=[
+                _vmem_spec((1, bq, Dp), lambda bh, qi, kj, koff: (bh, qi, 0)),
+                _vmem_spec((1, bk, Dp), lambda bh, qi, kj, koff: (bh, kj, 0)),
+                _vmem_spec((1, bk, Dp), lambda bh, qi, kj, koff: (bh, kj, 0)),
+                _vmem_spec((1, bq, 128), lambda bh, qi, kj, koff: (bh, qi, 0)),
+                _vmem_spec((1, bq, Dp), lambda bh, qi, kj, koff: (bh, qi, 0)),
+                _vmem_spec((1, bq, 128), lambda bh, qi, kj, koff: (bh, qi, 0)),
+            ],
+            out_specs=[
+                _vmem_spec((1, bq, Dp), lambda bh, qi, kj, koff: (bh, qi, 0)),
+            ],
+        ) if pltpu is not None else None,
+        out_shape=[_sds((B * H, Tqp, Dp), vma)],
+        compiler_params=cparams,
+        interpret=_interpret(),
+    )(koff, qf, kf, vf, mf, obf, lbf)[0]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **kern_kwargs),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B * H, Tkp // bk, Tqp // bq),
+            in_specs=[
+                _vmem_spec((1, bq, Dp), lambda bh, kj, qi, koff: (bh, qi, 0)),
+                _vmem_spec((1, bk, Dp), lambda bh, kj, qi, koff: (bh, kj, 0)),
+                _vmem_spec((1, bk, Dp), lambda bh, kj, qi, koff: (bh, kj, 0)),
+                _vmem_spec((1, bq, 128), lambda bh, kj, qi, koff: (bh, qi, 0)),
+                _vmem_spec((1, bq, Dp), lambda bh, kj, qi, koff: (bh, qi, 0)),
+                _vmem_spec((1, bq, 128), lambda bh, kj, qi, koff: (bh, qi, 0)),
+            ],
+            out_specs=[
+                _vmem_spec((1, bk, Dp), lambda bh, kj, qi, koff: (bh, kj, 0)),
+                _vmem_spec((1, bk, Dp), lambda bh, kj, qi, koff: (bh, kj, 0)),
+            ],
+        ) if pltpu is not None else None,
+        out_shape=[_sds((B * H, Tkp, Dp), vma),
+                   _sds((B * H, Tkp, Dp), vma)],
+        compiler_params=cparams,
+        interpret=_interpret(),
+    )(koff, qf, kf, vf, mf, obf, lbf)
+
+    def _unflat(x, t):
+        return jnp.transpose(
+            jnp.reshape(x[:, :t, :D], (B, H, t, D)), (0, 2, 1, 3))
+
+    return (_unflat(dq, Tq).astype(q.dtype),
+            _unflat(dk, Tk).astype(k.dtype),
+            _unflat(dv, Tk).astype(v.dtype))

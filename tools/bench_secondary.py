@@ -341,6 +341,118 @@ def bench_train(network, batch, baseline_img_s, iters=100,
     }
 
 
+def bench_transformer(layers=12, d_model=768, heads=12, T=1024, batch=8,
+                      vocab=32768, iters=60):
+    """Decoder-only transformer LM training throughput + MFU — the
+    framework's long-context flagship (models/transformer.py, flash-
+    attention kernel path on TPU).  FLOPs: 6·params·tokens for the
+    matmul stack + 6·L·B·T²·D for causal attention (the causal half —
+    the kernel skips future tiles, so counting full T² would inflate
+    MFU)."""
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    precision = os.environ.get("BENCH_PRECISION", "bf16")
+    # token ids stay f32 (exact); the model casts to bf16 after the
+    # embedding (models/transformer.py dtype param)
+    sym = models.transformer_lm(
+        vocab_size=vocab, seq_len=T, num_layers=layers, num_heads=heads,
+        d_model=d_model,
+        dtype="bfloat16" if precision == "bf16" else "float32")
+    ctx = mx.tpu() if mx.context.num_devices() else mx.cpu()
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (batch, T))],
+             label_shapes=[mx.io.DataDesc("softmax_label", (batch, T))],
+             for_training=True)
+    mx.random.seed(0)
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                          factor_type="avg", magnitude=3))
+    mod.init_optimizer(kvstore=None, optimizer="adam",
+                       optimizer_params={"learning_rate": 3e-4})
+    n_params = sum(int(np.prod(a.shape))
+                   for a in mod._exec.arg_dict.values()) - 2 * batch * T
+    tokens = batch * T
+    flops = 6 * n_params * tokens + 6 * layers * batch * T * T * d_model
+    log(f"transformer {layers}L d{d_model} T{T} b{batch}: "
+        f"{n_params/1e6:.1f}M params, {flops/1e12:.2f} TF/step")
+
+    rng = np.random.RandomState(0)
+    trans = rng.randint(1, vocab, size=(vocab, 2))
+    n_batches = 2
+    batches, labels_np = [], []
+    for _ in range(n_batches):
+        toks = np.empty((batch, T + 1), np.int64)
+        toks[:, 0] = rng.randint(1, vocab, size=batch)
+        for t in range(T):
+            toks[:, t + 1] = trans[toks[:, t], rng.randint(0, 2, size=batch)]
+        batches.append(mx.io.DataBatch(
+            [mx.nd.array(toks[:, :T].astype(np.float32), ctx=ctx)],
+            [mx.nd.array(toks[:, 1:].astype(np.float32), ctx=ctx)]))
+        labels_np.append(toks[:, 1:])
+    t0 = time.time()
+    for i in range(2):
+        mod.forward_backward(batches[i % n_batches])
+        mod.update()
+    mod.get_outputs()[0].wait_to_read()
+    out = np.asarray(mod.get_outputs()[0].asnumpy(), np.float32)
+    lab = labels_np[1 % n_batches]
+    loss_first = float(-np.mean(np.log(np.maximum(
+        np.take_along_axis(out, lab[..., None], axis=-1), 1e-12))))
+    log(f"transformer warmup+compile {time.time()-t0:.1f}s")
+
+    windows, per_window, window_ms, done = 5, max(iters // 5, 1), [], 0
+    for _ in range(windows):
+        t0 = time.time()
+        for i in range(per_window):
+            mod.forward_backward(batches[(done + i) % n_batches])
+            mod.update()
+        mod.get_outputs()[0].wait_to_read()
+        window_ms.append((time.time() - t0) / per_window * 1000)
+        done += per_window
+    out = np.asarray(mod.get_outputs()[0].asnumpy(), np.float32)
+    lab = labels_np[(done - 1) % n_batches]
+    loss_last = float(-np.mean(np.log(np.maximum(
+        np.take_along_axis(out, lab[..., None], axis=-1), 1e-12))))
+
+    def run_steps(n):
+        for i in range(n):
+            mod.forward_backward(batches[i % n_batches])
+            mod.update()
+        mod.get_outputs()[0].wait_to_read()
+
+    dev_ms = _device_step_ms(run_steps)
+    best = min(window_ms)
+    canary_ok = loss_last < loss_first
+    peak = 197.0 if "v5 lite" in str(jax.devices()[0]) else None
+    mfu_dev = (round(flops / 1e12 / (dev_ms / 1e3) / peak, 4)
+               if dev_ms and peak else None)
+    log(f"transformer window ms/step: "
+        + ", ".join(f"{m:.2f}" for m in window_ms)
+        + (f"; device {dev_ms:.2f} ms -> MFU {mfu_dev}" if dev_ms else "")
+        + f"; loss {loss_first:.3f}->{loss_last:.3f} "
+        f"({'OK' if canary_ok else 'FAILED'})")
+    if not canary_ok:
+        raise SystemExit("transformer loss did not fall")
+    return {
+        "metric": "transformer_lm_train_throughput",
+        "value": round(tokens * 1000 / best, 1),
+        "unit": "tokens/s/chip",
+        "config": {"layers": layers, "d_model": d_model, "heads": heads,
+                   "seq_len": T, "batch": batch, "vocab": vocab,
+                   "params_m": round(n_params / 1e6, 1)},
+        "precision": precision,
+        "step_ms": round(best, 3),
+        "step_ms_median": round(float(np.median(window_ms)), 3),
+        "step_ms_device": round(dev_ms, 3) if dev_ms else None,
+        "tokens_per_s_device": (round(tokens * 1000 / dev_ms, 1)
+                                if dev_ms else None),
+        "mfu_device": mfu_dev,
+        "loss_first": round(loss_first, 4),
+        "loss_last": round(loss_last, 4),
+    }
+
+
 def main():
     results = []
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
@@ -354,6 +466,8 @@ def main():
                                image_shape=(3, 299, 299)))
     print(json.dumps(results[-1]), flush=True)
     results.append(bench_train("alexnet", 256, 1869.69))
+    print(json.dumps(results[-1]), flush=True)
+    results.append(bench_transformer())
     print(json.dumps(results[-1]), flush=True)
     with open(os.path.join(_REPO, "BENCH_SECONDARY.json"), "w") as f:
         json.dump({"device": str(jax.devices()[0]), "results": results},
