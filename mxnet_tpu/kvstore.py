@@ -146,6 +146,57 @@ class KVStore:
             self._updater.set_states(f.read())
 
 
+def _maybe_init_distributed(kv_type: str):
+    """Wire the JAX distributed runtime from the launcher env (must run
+    before any jax call that would initialize the XLA backend).  Only
+    attempted when the launcher (tools/launch.py) or the cluster env
+    configured a coordinator; shared by the 'tpu' mesh store and the
+    dist_* stores (reference: ps-lite Postoffice::Start,
+    kvstore_dist.h:33-38 — connect or die)."""
+    import logging
+    import os
+
+    coord = os.environ.get("MXNET_COORDINATOR")
+    kwargs = {}
+    if coord:
+        for var in ("MXNET_NUM_WORKERS", "MXNET_WORKER_ID"):
+            if var not in os.environ:
+                raise MXNetError(
+                    f"MXNET_COORDINATOR is set but {var} is missing — "
+                    "use tools/launch.py or export the full launcher "
+                    "environment")
+        kwargs = dict(
+            coordinator_address=coord,
+            num_processes=int(os.environ["MXNET_NUM_WORKERS"]),
+            process_id=int(os.environ["MXNET_WORKER_ID"]))
+    if coord or "JAX_COORDINATOR_ADDRESS" in os.environ or \
+            "COORDINATOR_ADDRESS" in os.environ:
+        try:
+            jax.distributed.initialize(**kwargs)
+        except RuntimeError as exc:
+            if "already" in str(exc).lower():
+                pass  # launcher/driver initialized it — fine
+            else:
+                # the launcher asked for N processes; degrading to
+                # single-process would train on 1/N of the data while
+                # looking healthy (the reference's ps-lite connects or
+                # dies, kvstore_dist.h:33-38) — so die too
+                nproc = int(kwargs.get(
+                    "num_processes",
+                    os.environ.get("JAX_NUM_PROCESSES",
+                                   os.environ.get("NUM_PROCESSES", "1"))))
+                if nproc > 1:
+                    raise MXNetError(
+                        f"kvstore {kv_type!r}: jax.distributed.initialize "
+                        f"failed with {nproc} configured processes: {exc}. "
+                        "Initialize the distributed runtime before any "
+                        "jax array is created.") from exc
+                logging.warning(
+                    "kvstore %r: jax.distributed.initialize failed (%s); "
+                    "single configured process — proceeding locally.",
+                    kv_type, exc)
+
+
 class TPUKVStore(KVStore):
     """'tpu' flavor — the reference's 'device' reimagined on the ICI
     mesh (SURVEY §5.8): values live replicated/sharded on a
@@ -154,9 +205,17 @@ class TPUKVStore(KVStore):
     push/pull traffic at all in the Module fast path.  ``mesh_plan``
     (a ``mxnet_tpu.parallel.MeshPlan``) is attached by the Module that
     activates it; the local push/pull API stays usable for tooling.
+
+    Under a launcher (MXNET_COORDINATOR set) the store wires the JAX
+    distributed runtime and the Module's mesh then spans every host's
+    devices: each process feeds its host-local batch
+    (``MeshPlan.stage_input`` → ``host_local_array_to_global_array``)
+    and the in-program psum rides ICI within a host and DCN across
+    hosts — tested by tests/test_dist.py::test_launch_module_fit_tpu_mesh.
     """
 
     def __init__(self, kv_type="tpu"):
+        _maybe_init_distributed(kv_type)
         super().__init__(kv_type)
         self.mesh_plan = None
 
@@ -167,64 +226,21 @@ class DistKVStore(TPUKVStore):
 
     Processes are launched with the standard JAX multi-process env
     (coordinator address + process id); ``jax.distributed.initialize``
-    wires DCN, ranks map to ``jax.process_index``, and the mesh spans
-    all hosts so the in-program psum rides ICI within a slice and DCN
-    across slices.  Barrier = a tiny all-device collective rendezvous.
+    wires DCN and ranks map to ``jax.process_index``.  Each process
+    runs its own local program; 'dist_sync' aggregates gradients with
+    a cross-process allgather-sum + replicated updater, 'dist_async'
+    talks to the parameter server (mxnet_tpu.ps).  For the
+    single-global-program alternative — ONE mesh spanning every host
+    with the psum inside the jitted step — use ``kvstore='tpu'`` under
+    the launcher (see TPUKVStore).  Barrier = a tiny all-device
+    collective rendezvous.
     """
 
     def __init__(self, kv_type="dist_sync"):
-        super().__init__(kv_type)
-        import logging
-        import os
-
-        # wire the distributed runtime BEFORE any jax call that would
-        # initialize the XLA backend (jax.distributed.initialize must
-        # run first in the process); only attempted when the launcher
-        # (tools/launch.py) or the cluster env configured a coordinator
         self._async = kv_type in ("dist_async", "dist_device_async")
         self._ps_server = None
         self._ps = None
-        coord = os.environ.get("MXNET_COORDINATOR")
-        kwargs = {}
-        if coord:
-            for var in ("MXNET_NUM_WORKERS", "MXNET_WORKER_ID"):
-                if var not in os.environ:
-                    raise MXNetError(
-                        f"MXNET_COORDINATOR is set but {var} is missing — "
-                        "use tools/launch.py or export the full launcher "
-                        "environment")
-            kwargs = dict(
-                coordinator_address=coord,
-                num_processes=int(os.environ["MXNET_NUM_WORKERS"]),
-                process_id=int(os.environ["MXNET_WORKER_ID"]))
-        if coord or "JAX_COORDINATOR_ADDRESS" in os.environ or \
-                "COORDINATOR_ADDRESS" in os.environ:
-            import jax
-
-            try:
-                jax.distributed.initialize(**kwargs)
-            except RuntimeError as exc:
-                if "already" in str(exc).lower():
-                    pass  # launcher/driver initialized it — fine
-                else:
-                    # the launcher asked for N processes; degrading to
-                    # single-process would train on 1/N of the data while
-                    # looking healthy (the reference's ps-lite connects or
-                    # dies, kvstore_dist.h:33-38) — so die too
-                    nproc = int(kwargs.get(
-                        "num_processes",
-                        os.environ.get("JAX_NUM_PROCESSES",
-                                       os.environ.get("NUM_PROCESSES", "1"))))
-                    if nproc > 1:
-                        raise MXNetError(
-                            f"kvstore {kv_type!r}: jax.distributed.initialize "
-                            f"failed with {nproc} configured processes: {exc}. "
-                            "Initialize the distributed runtime before any "
-                            "jax array is created.") from exc
-                    logging.warning(
-                        "kvstore %r: jax.distributed.initialize failed (%s); "
-                        "single configured process — proceeding locally.",
-                        kv_type, exc)
+        super().__init__(kv_type)  # TPUKVStore wires the dist runtime
         self._start_heartbeat()
         if self._async:
             self._start_parameter_server()
@@ -288,6 +304,23 @@ class DistKVStore(TPUKVStore):
             for k, v in zip(keys, values):
                 arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
                 self._ps.init(k, arr)  # first worker's init wins
+            return
+        if jax.process_count() > 1:
+            # sync path: rank 0's init wins for ALL workers (the
+            # reference dist store serves the first-arriving init to
+            # every worker, kvstore_dist_server.h:150-163) — without
+            # this, differently-seeded workers would keep divergent
+            # local weights and the replicated updater would silently
+            # produce garbage.  Broadcast the values, then delegate so
+            # the init contract (dup check, storage) lives in one place.
+            from jax.experimental import multihost_utils
+
+            keys, values = _key_value(key, value)
+            hosts = [v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+                     for v in values]
+            hosts = multihost_utils.broadcast_one_to_all(hosts)
+            super().init(keys, [NDArray(jnp.asarray(np.asarray(h)))
+                                for h in hosts])
             return
         super().init(key, value)
 

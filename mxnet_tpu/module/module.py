@@ -350,9 +350,36 @@ class Module(BaseModule):
                             groups.setdefault(i.name, g)
         for name, shapes in (self._data_shapes or []):
             plan.check_batch(shapes[plan.batch_axis] if shapes else 0)
+        spans = plan.spans_processes
+        bcast = {}
+        if spans:
+            from jax.experimental import multihost_utils
+
+            # ONE pytree broadcast for every local param/aux value —
+            # per-array broadcasts would be hundreds of sequential
+            # cross-host round-trips at bind time
+            to_sync = {}
+            for name, arr in list(self._exec.arg_dict.items()) + \
+                    list(self._exec.aux_dict.items()):
+                if name not in input_names and \
+                        getattr(arr._data, "is_fully_addressable", True):
+                    to_sync[name] = np.asarray(arr._data)
+            if to_sync:
+                bcast = multihost_utils.broadcast_one_to_all(to_sync)
         for name, arr in self._exec.arg_dict.items():
             if name in input_names:
                 sh = plan.input_sharding(arr.ndim)
+                if spans:
+                    # process-spanning mesh: the jitted program sees the
+                    # GLOBAL batch (local × batch_scale); allocate the
+                    # executor's input buffer at global shape — each
+                    # process's data iter keeps yielding local batches,
+                    # staged in forward() via MeshPlan.stage_input
+                    if getattr(arr._data, "is_fully_addressable", True):
+                        arr._sharding = sh
+                        arr._data = plan.stage_input(
+                            np.zeros(tuple(arr.shape), arr.dtype), arr.ndim)
+                    continue
             else:
                 shard = attrs.get(name, {}).get("__shard__")
                 if shard is None and name in groups:
@@ -370,14 +397,32 @@ class Module(BaseModule):
                             shard = None
                 sh = plan.param_sharding(arr.ndim, shard)
             arr._sharding = sh
-            arr._set_data(arr._data)  # re-place via the sharding pin
+            if spans:
+                # unify the per-process initializations: rank 0's value
+                # wins everywhere (the reference's first-init-wins,
+                # kvstore_dist_server.h:150-163) BEFORE the replicated
+                # global placement — divergent local inits would
+                # otherwise silently violate the replication invariant
+                if name in bcast:
+                    arr._data = plan.place(np.asarray(bcast[name]), sh)
+            else:
+                arr._set_data(arr._data)  # re-place via the sharding pin
             g = self._exec.grad_dict.get(name)
             if g is not None:
                 g._sharding = sh
-                g._set_data(g._data)
+                if spans:
+                    if getattr(g._data, "is_fully_addressable", True):
+                        g._data = plan.place(np.asarray(g._data), sh)
+                else:
+                    g._set_data(g._data)
         for name, arr in self._exec.aux_dict.items():
             arr._sharding = plan.replicated()
-            arr._set_data(arr._data)
+            if spans:
+                if name in bcast:
+                    arr._data = plan.place(np.asarray(bcast[name]),
+                                           arr._sharding)
+            else:
+                arr._set_data(arr._data)
 
     # ------------------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -498,6 +543,22 @@ class Module(BaseModule):
         if self._label_names and data_batch.label:
             for name, arr in zip(self._label_names, data_batch.label):
                 kwargs[name] = arr
+        plan = self._mesh_plan
+        if plan is not None and plan.spans_processes:
+            # each process supplies its host-local batch; stage it as
+            # this process's chunk of the global 'dp'-sharded array
+            # (host_local_array_to_global_array under the hood) so the
+            # ONE global program sees the full cross-host batch
+            from ..ndarray import NDArray as _ND
+            for name, v in list(kwargs.items()):
+                tgt = self._exec.arg_dict.get(name)
+                if tgt is None or not isinstance(v, _ND):
+                    continue
+                if not getattr(tgt._sharding, "is_fully_addressable", True) \
+                        and getattr(v._data, "is_fully_addressable", True):
+                    staged = plan.stage_input(
+                        v.asnumpy().astype(tgt.dtype), tgt.ndim)
+                    kwargs[name] = _ND(staged, sharding=tgt._sharding)
         if is_train and self._fused_ready():
             # defer: the fused program runs in update() with this batch
             self._pending_batch = kwargs
@@ -632,9 +693,17 @@ class Module(BaseModule):
         # returned by the step so steady state does zero scalar
         # host→device transfers.  On a mesh they live replicated.
         if self._mesh_plan is not None:
-            rep = self._mesh_plan.replicated()
-            self._fused_t = jax.device_put(np.int32(self._step_count), rep)
-            self._fused_key = jax.device_put(_random.next_key(), rep)
+            plan = self._mesh_plan
+            rep = plan.replicated()
+            key = _random.next_key()  # raw uint32 (2,) threefry key
+            if plan.spans_processes:
+                # one PRNG stream for the ONE global program: rank 0's
+                # key wins (identical dropout masks on every host)
+                from jax.experimental import multihost_utils
+                key = np.asarray(multihost_utils.broadcast_one_to_all(
+                    np.asarray(key)))
+            self._fused_t = plan.place(np.int32(self._step_count), rep)
+            self._fused_key = plan.place(key, rep)
         else:
             with jax.default_device(dev):
                 self._fused_t = jnp.int32(self._step_count)
@@ -653,8 +722,8 @@ class Module(BaseModule):
             if len(self._lr_cache) >= 64:
                 self._lr_cache.clear()  # per-step schedulers: don't leak
             if self._mesh_plan is not None:
-                lr_dev = jax.device_put(np.float32(lr),
-                                        self._mesh_plan.replicated())
+                lr_dev = self._mesh_plan.place(
+                    np.float32(lr), self._mesh_plan.replicated())
             else:
                 with jax.default_device(dev):
                     lr_dev = jnp.float32(lr)
@@ -763,6 +832,12 @@ class Module(BaseModule):
         for n, v in new_aux.items():
             self._exec.aux_dict[n]._set_data(v)
         self._fused_state = new_states
+        if self._mesh_plan is not None and self._mesh_plan.spans_processes:
+            # per-worker view: metrics/logging consume this process's
+            # slice of the global outputs (same per-shard semantics as
+            # the reference's per-worker executor outputs)
+            outs = [jnp.asarray(self._mesh_plan.local_output(o))
+                    for o in outs]
         self._exec.outputs_cache = [NDArray(o, self._context[0]) for o in outs]
 
     def get_outputs(self, merge_multi_context=True):
@@ -783,7 +858,27 @@ class Module(BaseModule):
             # grad_req='add': leave gradients untouched — an output query
             # must not accumulate a contribution; the user's backward()
             # call does it exactly once
-        return self._exec.outputs
+        outs = self._exec.outputs
+        if self._mesh_plan is not None and self._mesh_plan.spans_processes:
+            # plain-path (score/predict/pre-update get_outputs) parity
+            # with _run_fused_step: hand back this process's slice of
+            # any global output so it pairs with the host-local labels
+            import jax.numpy as jnp
+            from ..ndarray import NDArray as _ND
+            plan = self._mesh_plan
+            changed = False
+            local = []
+            for o in outs:
+                if not getattr(o._data, "is_fully_addressable", True):
+                    local.append(_ND(jnp.asarray(plan.local_output(o._data)),
+                                     self._context[0]))
+                    changed = True
+                else:
+                    local.append(o)
+            if changed:
+                self._exec.outputs_cache = local
+            outs = local
+        return outs
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and self.inputs_need_grad

@@ -494,9 +494,9 @@ def flash_attention_bwd(q, k, v, m, o_bar, l_bar, causal, block_size,
     lbf = _pad_to(jnp.broadcast_to(
         jnp.reshape(l_bar.astype(jnp.float32), (B * H, Tq))[..., None],
         (B * H, Tq, 128)), 1, bq)
-    # padded q rows: m = -inf there -> p = 0 -> no contribution
-    if mf.shape[1] > Tq:
-        pass
+    # padded q rows contribute nothing because their o_bar/l_bar cotangent
+    # rows are zero-padded (m is zero-padded there, so p=1, but every term
+    # it multiplies is 0).
     Dp, Tqp, Tkp = qf.shape[2], qf.shape[1], kf.shape[1]
     try:
         vma = (jax.typeof(qf).vma | jax.typeof(kf).vma | jax.typeof(vf).vma

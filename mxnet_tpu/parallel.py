@@ -84,6 +84,48 @@ class MeshPlan:
     def num_devices(self) -> int:
         return self.dp * self.tp
 
+    @property
+    def spans_processes(self) -> bool:
+        """True when the mesh includes devices of other processes — the
+        v5e-pod execution model: ONE jitted program over a global mesh,
+        each process feeding its host-local batch shard (reference
+        multi-node role: kvstore_dist.h:28-318, re-expressed as XLA
+        collectives over ICI/DCN instead of ps-lite push/pull)."""
+        import jax
+
+        me = jax.process_index()
+        return any(d.process_index != me for d in self.devices)
+
+    @property
+    def batch_scale(self) -> int:
+        """Global batch = local batch × this (how many process-chunks
+        tile the 'dp' axis; 1 on a single-process mesh)."""
+        if not self.spans_processes:
+            return 1
+        import jax
+
+        # every dp row must live entirely on one process: a row co-owned
+        # by two processes would have each stage a *different* local
+        # batch as the same global chunk — silent divergence.  (This also
+        # rejects tp-across-hosts, deliberately: tensor parallelism
+        # belongs on ICI within a host, not DCN.)
+        row_owner = {}
+        for i, d in enumerate(self.devices):
+            row = i // self.tp
+            prev = row_owner.setdefault(row, d.process_index)
+            if prev != d.process_index:
+                raise MXNetError(
+                    f"dp row {row} spans processes {prev} and "
+                    f"{d.process_index}; a process-spanning mesh needs "
+                    "each dp row on one host (keep tp within a host)")
+        me = jax.process_index()
+        local_dp = {r for r, p in row_owner.items() if p == me}
+        if not local_dp or self.dp % len(local_dp) != 0:
+            raise MXNetError(
+                f"process-spanning mesh needs every process to own whole "
+                f"dp rows; dp={self.dp}, local rows={sorted(local_dp)}")
+        return self.dp // len(local_dp)
+
     # -- shardings ------------------------------------------------------
     def _named(self, spec):
         from jax.sharding import NamedSharding
@@ -125,15 +167,57 @@ class MeshPlan:
 
     # -- placement ------------------------------------------------------
     def place(self, value, sharding):
-        """device_put a host or device array onto the mesh placement."""
+        """Place a host or device array onto the mesh placement.
+
+        On a process-spanning mesh the sharding is not fully addressable
+        and ``jax.device_put`` of a local array can't populate remote
+        shards — build the global array from this process's addressable
+        pieces instead (every process must hold the same full ``value``,
+        the replicated-parameter invariant)."""
         import jax
 
-        return jax.device_put(value, sharding)
+        if getattr(sharding, "is_fully_addressable", True):
+            return jax.device_put(value, sharding)
+        host = np.asarray(value)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+
+    def stage_input(self, value, ndim: Optional[int] = None):
+        """Host-local batch → global mesh array: the process's chunk of
+        the 'dp'-sharded global batch rides
+        ``multihost_utils.host_local_array_to_global_array`` (the judge
+        path for feeding a pod: each host stages only its own rows; no
+        host ever materializes the global batch)."""
+        from jax.experimental import multihost_utils
+
+        host = np.asarray(value)
+        nd = host.ndim if ndim is None else ndim
+        sh = self.input_sharding(nd)
+        if not self.spans_processes:
+            import jax
+
+            return jax.device_put(host, sh)
+        return multihost_utils.host_local_array_to_global_array(
+            host, self.mesh, sh.spec)
+
+    def local_output(self, garr):
+        """Global program output → this process's host-local slice (the
+        inverse of ``stage_input``, for per-worker metrics/logging)."""
+        from jax.experimental import multihost_utils
+
+        if getattr(garr.sharding, "is_fully_addressable", True):
+            return garr
+        return multihost_utils.global_array_to_host_local_array(
+            garr, self.mesh, garr.sharding.spec)
 
     def check_batch(self, batch_size: int):
-        if batch_size % self.dp != 0:
+        """``batch_size`` is the PER-PROCESS batch; the global batch
+        (batch × batch_scale) must tile the 'dp' axis."""
+        if (batch_size * self.batch_scale) % self.dp != 0:
             raise MXNetError(
-                f"batch size {batch_size} not divisible by dp={self.dp}")
+                f"batch size {batch_size} (global "
+                f"{batch_size * self.batch_scale}) not divisible by "
+                f"dp={self.dp}")
 
 
 def make_plan(contexts: Optional[Sequence[Context]] = None, tp: int = 1,

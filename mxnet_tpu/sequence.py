@@ -106,9 +106,9 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
         # the Pallas flash kernel's interpret-mode lowering (CPU tests)
         # mixes sp-varying operands with unvarying grid indices in its
         # block dynamic_slices; vma checking rejects that pairing, so
-        # follow JAX's prescribed workaround — but ONLY on the kernel
-        # path, so the lax path keeps full varying-axis checking
-        check_vma=not _pk.enabled())
+        # follow JAX's prescribed workaround — scoped to interpret mode
+        # only, so native TPU runs and the lax path keep full checking
+        check_vma=not (_pk.enabled() and _pk._interpret()))
     return fn(q, k, v)
 
 
@@ -144,5 +144,5 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
         functools.partial(_ulysses_local, axis_name=axis_name,
                           causal=causal, block_size=block_size),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=not _pk.enabled())
+        check_vma=not (_pk.enabled() and _pk._interpret()))
     return fn(q, k, v)

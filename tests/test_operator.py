@@ -188,6 +188,27 @@ def test_batchnorm_train_inference():
     assert_almost_equal(out_inf.asnumpy(), ref_inf, rtol=1e-3, atol=1e-4)
 
 
+def test_norm_large_mean_no_cancellation():
+    # |mean| >> std regime: the single-pass E[(x-s)^2] - E[x-s]^2 statistics
+    # must not catastrophically cancel in f32 (round-4 advisor finding)
+    x = (RNG.randn(8, 4, 6, 6).astype(np.float32) * 0.01 + 1000.0)
+    out = mx.nd.BatchNorm(
+        mx.nd.array(x), mx.nd.ones(4), mx.nd.zeros(4),
+        mx.nd.zeros(4), mx.nd.ones(4), is_train=True, eps=1e-5).asnumpy()
+    m = x.mean(axis=(0, 2, 3), keepdims=True)
+    v = ((x - m) ** 2).mean(axis=(0, 2, 3), keepdims=True)
+    ref = (x - m) / np.sqrt(v + 1e-5)
+    # tolerance is input-representation-limited (f32 at |x|~1e3 holds ~1e-4)
+    assert np.abs(out - ref).max() < 2e-2
+    x2 = (RNG.randn(16, 32).astype(np.float32) * 0.01 + 1000.0)
+    o2 = mx.nd.LayerNorm(mx.nd.array(x2), mx.nd.ones(32), mx.nd.zeros(32),
+                         eps=1e-5).asnumpy()
+    m2 = x2.mean(axis=1, keepdims=True)
+    v2 = ((x2 - m2) ** 2).mean(axis=1, keepdims=True)
+    r2 = (x2 - m2) / np.sqrt(v2 + 1e-5)
+    assert np.abs(o2 - r2).max() < 2e-2
+
+
 def test_softmax_output_grad():
     # backward = (p - onehot) * scale, ignoring head grads
     x = RNG.rand(4, 5).astype(np.float32)
