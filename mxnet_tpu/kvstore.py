@@ -237,72 +237,94 @@ class DistKVStore(TPUKVStore):
     """
 
     def __init__(self, kv_type="dist_sync"):
+        import os
+
         self._async = kv_type in ("dist_async", "dist_device_async")
+        # server-side sync updates (reference architecture: the updater
+        # runs on the server after NumWorkers pushes, workers stateless
+        # — kvstore_dist_server.h:136-219); default stays the replicated
+        # updater, which needs no server round-trips
+        self._server_sync = (not self._async and os.environ.get(
+            "MXNET_KVSTORE_SYNC_ON_SERVER", "0") == "1")
         self._ps_server = None
         self._ps = None
+        self._sync_round: Dict[Any, int] = {}
+        self._key_meta: Dict[Any, tuple] = {}  # key → (shape, dtype)
         super().__init__(kv_type)  # TPUKVStore wires the dist runtime
         self._start_heartbeat()
-        if self._async:
+        if self._async or self._server_sync:
             self._start_parameter_server()
 
-    # -- async parameter server (reference: kvstore_dist_server.h) -----
+    # -- parameter servers (reference: kvstore_dist_server.h) ----------
     def _start_parameter_server(self):
-        """'dist_async': rank 0 hosts a ParameterServer thread applying
-        pushes on arrival (update-on-arrival consistency, the reference
-        async branch kvstore_dist_server.h:199-207); every rank holds a
-        PSClient.  Single-process creation keeps the local in-memory
-        semantics (no server) so unit tests/tools work unlaunched."""
+        """Every rank hosts one ParameterServer shard; every rank holds
+        a ShardedPSClient over all of them.  Small keys hash to one
+        shard, big arrays split across all (kvstore_dist.h:264-302).
+        'dist_async' shards apply pushes on arrival
+        (kvstore_dist_server.h:199-207); the server-sync mode
+        accumulates NumWorkers pushes then updates once
+        (kvstore_dist_server.h:136-198).  Single-process creation keeps
+        the local in-memory semantics (no server) so unit tests/tools
+        work unlaunched."""
         import jax
 
         if jax.process_count() == 1:
             self._async = False  # local: async == sync semantics
+            self._server_sync = False
             return
+        import os
+        import socket as _socket
+
         import numpy as _np
         from jax.experimental import multihost_utils
 
-        from .ps import ParameterServer, PSClient
+        from .ps import ParameterServer, ShardedPSClient
 
-        # rank 0 binds an ephemeral port and announces its own
-        # reachable (host, port) — the coordinator may live on a
-        # different machine, so the server's address must come from
-        # rank 0 itself
-        port = 0
-        host_b = b""
-        if self.rank == 0:
-            import socket as _socket
+        # the HMAC secret guarding the (pickled) optimizer payload rides
+        # the trusted JAX-coordinator control plane from rank 0
+        secret = _np.frombuffer(os.urandom(32), _np.uint8)
+        secret = bytes(_np.asarray(
+            multihost_utils.broadcast_one_to_all(secret), _np.uint8))
 
-            self._ps_server = ParameterServer()
-            port = self._ps_server.port
-            # announce the address of the interface that actually
-            # reaches the other workers — gethostbyname(gethostname())
-            # resolves to 127.0.1.1 on stock hosts.  A connected UDP
-            # socket towards the coordinator reveals the outbound
-            # interface without sending a packet.
-            coord_env = __import__("os").environ.get(
-                "MXNET_COORDINATOR", "")
+        # each rank binds its shard on the interface that actually
+        # reaches the peers — gethostbyname(gethostname()) resolves to
+        # 127.0.1.1 on stock hosts; a connected UDP socket towards the
+        # coordinator reveals the outbound interface without sending a
+        # packet
+        coord_env = os.environ.get("MXNET_COORDINATOR", "")
+        host_b = b"127.0.0.1"
+        try:
+            chost = coord_env.rsplit(":", 1)[0] or "8.8.8.8"
+            probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
             try:
-                chost = coord_env.rsplit(":", 1)[0] or "8.8.8.8"
-                probe = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
-                try:
-                    probe.connect((chost, 1))
-                    host_b = probe.getsockname()[0].encode()
-                finally:
-                    probe.close()
-            except OSError:
-                host_b = b"127.0.0.1"
+                probe.connect((chost, 1))
+                host_b = probe.getsockname()[0].encode()
+            finally:
+                probe.close()
+        except OSError:
+            pass
+        self._ps_server = ParameterServer(
+            host=host_b.decode(), secret=secret,
+            num_workers=self.num_workers, sync=self._server_sync)
+
+        # allgather every shard's (port, host) — ordered by rank
         msg = _np.zeros(65, _np.int32)
-        msg[0] = port
+        msg[0] = self._ps_server.port
         msg[1:1 + len(host_b)] = _np.frombuffer(host_b, _np.uint8)
-        msg = multihost_utils.broadcast_one_to_all(msg)
-        port = int(msg[0])
-        host = bytes(msg[1:][msg[1:] > 0].astype(_np.uint8)).decode()
-        self._ps = PSClient(host or "127.0.0.1", port)
+        all_msgs = _np.asarray(multihost_utils.process_allgather(
+            msg[None, :], tiled=True))
+        addrs = []
+        for row in all_msgs:
+            h = bytes(row[1:][row[1:] > 0].astype(_np.uint8)).decode()
+            addrs.append((h or "127.0.0.1", int(row[0])))
+        self._ps = ShardedPSClient(addrs, secret=secret)
 
     def init(self, key, value):
         if self._ps is not None:
             keys, values = _key_value(key, value)
             for k, v in zip(keys, values):
                 arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+                self._key_meta[k] = (arr.shape, arr.dtype)
                 self._ps.init(k, arr)  # first worker's init wins
             return
         if jax.process_count() > 1:
@@ -354,13 +376,19 @@ class DistKVStore(TPUKVStore):
         import jax
 
         if self._ps is not None:
-            # async: each push is applied by the server the moment it
-            # arrives — no cross-worker rendezvous of any kind
+            # async: each push is applied by its shard the moment it
+            # arrives — no cross-worker rendezvous of any kind.
+            # server-sync: the shard accumulates NumWorkers pushes and
+            # updates once; the matching pull waits for that round
             keys, values = _key_value_lists(key, value)
             for k, vlist in zip(keys, values):
                 merged = vlist[0]._data if len(vlist) == 1 else _tree_sum(
                     tuple(v._data for v in vlist))
-                self._ps.push(k, np.asarray(merged))
+                if self._server_sync:
+                    self._sync_round[k] = self._sync_round.get(k, 0) + 1
+                    self._ps.push_sync(k, np.asarray(merged))
+                else:
+                    self._ps.push(k, np.asarray(merged))
             return
         if jax.process_count() == 1:
             return super().push(key, value, priority)
@@ -385,7 +413,13 @@ class DistKVStore(TPUKVStore):
             assert out is not None
             keys, outs = _key_value_lists(key, out)
             for k, olist in zip(keys, outs):
-                cur = self._ps.pull(k)  # current weights, no barrier
+                shape, dtype = self._key_meta.get(k, (None, None))
+                # async: current weights, no barrier.  server-sync:
+                # wait for the round this worker's pushes belong to
+                cur = self._ps.pull(
+                    k, shape=shape, dtype=dtype,
+                    min_round=self._sync_round.get(k, 0)
+                    if self._server_sync else 0)
                 for o in olist:
                     o._set_data(jnp.asarray(cur).astype(o.dtype))
             return

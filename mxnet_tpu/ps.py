@@ -1,80 +1,214 @@
-"""Asynchronous parameter server — the 'dist_async' backend.
+"""Parameter servers — the 'dist_async' backend and the optional
+server-side-update 'dist_sync' mode.
 
 The reference's async mode runs an updater on a server process and
 applies every worker push the moment it arrives, with pulls returning
 whatever the weights currently are — no cross-worker barrier
-(``src/kvstore/kvstore_dist_server.h:199-207``: ``if (async_) {
-exec_.Exec([this, key, merged]() { updater_(key, merged, &stored); })
-}``).  ps-lite carried the bytes.
+(``src/kvstore/kvstore_dist_server.h:199-207``).  Its sync mode
+accumulates NumWorkers pushes per key, applies the updater ONCE
+server-side, and lets the workers' pulls wait for the new round —
+workers stay stateless (``kvstore_dist_server.h:136-198``).  ps-lite
+carried raw buffers and sharded keys across S servers: a small key
+lives on server ``(key * 9973) % S`` and a big array (>
+``MXNET_KVSTORE_BIGARRAY_BOUND`` elements, default 1e6) is split flat
+and contiguously across ALL servers (``kvstore_dist.h:264-302``).
 
-Here the server is a thread on rank 0 speaking a length-prefixed
-pickle protocol over TCP (the DCN path); workers connect lazily and
-each request is served under a per-server lock, so updates are applied
-in arrival order — stragglers never stall fast workers, which is the
-consistency/throughput trade the reference's async mode makes.
+TPU-native differences are deliberate:
+* every worker process hosts one server thread (no separate server
+  jobs — the JAX runtime already gives us one process per host), so
+  S == num_workers and shard traffic spreads across all hosts' NICs;
+* tensors ride a length-prefixed dtype/shape/raw-bytes framing — NO
+  pickle on the wire, so a reachable port is not an arbitrary-code-
+  execution surface.  The one structured payload (the optimizer, which
+  the reference also pickles — python/mxnet/kvstore.py:232-252) must
+  carry an HMAC keyed by a launcher-distributed secret; a frame with a
+  bad MAC is rejected before unpickling;
+* servers bind the announced interface (the one that reaches the
+  coordinator), not 0.0.0.0.
 
-The server port is chosen ephemerally by rank 0 and announced to the
-other processes with ``multihost_utils.broadcast_one_to_all`` over the
-already-initialized JAX distributed runtime.
+Addresses and the HMAC secret are exchanged over the already-
+initialized JAX distributed runtime (``broadcast_one_to_all`` /
+``process_allgather``) — the trusted control plane.
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac
+import os
 import pickle
 import socket
 import socketserver
 import struct
 import threading
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .base import MXNetError
 
-__all__ = ["ParameterServer", "PSClient"]
+__all__ = ["ParameterServer", "PSClient", "ShardedPSClient",
+           "server_of", "split_sizes", "bigarray_bound"]
 
-_HDR = struct.Struct("!I")
+_U32 = struct.Struct("!I")
+_U64 = struct.Struct("!Q")
+_I64 = struct.Struct("!q")
+
+# ops
+_INIT, _PUSH, _PULL, _SET_OPT, _NUM_APPLIED, _STOP, _PUSH_SYNC = range(1, 8)
 
 
-def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+def bigarray_bound() -> int:
+    """reference: MXNET_KVSTORE_BIGARRAY_BOUND, comm.h:65 (elements)."""
+    return int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", 1000 * 1000))
 
 
-def _recv_msg(sock: socket.socket):
-    hdr = _recv_exact(sock, _HDR.size)
-    (n,) = _HDR.unpack(hdr)
-    return pickle.loads(_recv_exact(sock, n))
+def server_of(key, num_servers: int) -> int:
+    """Small-key placement: the reference's load-balance hash
+    ``(key * 9973) % num_servers`` (kvstore_dist.h:276-281); string
+    keys hash through crc32 first.  Must classify keys exactly like
+    ``_pack_key`` (int vs np.integer included) or the same wire key
+    would shard differently per call site."""
+    k = int(key) if isinstance(key, (int, np.integer)) \
+        else zlib.crc32(str(key).encode())
+    return (k * 9973) % num_servers
+
+
+def split_sizes(size: int, num_servers: int) -> List[int]:
+    """Balanced contiguous flat split of a big array — the reference's
+    ``round(size/S*(i+1)) - round(size/S*i)`` partition
+    (kvstore_dist.h:286-296)."""
+    return [int(round(size / num_servers * (i + 1)))
+            - int(round(size / num_servers * i))
+            for i in range(num_servers)]
+
+
+# ---------------------------------------------------------------------------
+# wire format: u32 frame length | u8 op/status | typed fields.
+# Tensors are dtype/shape/raw-bytes — never pickled.
+# ---------------------------------------------------------------------------
+
+
+def _pack_key(key) -> bytes:
+    if isinstance(key, (int, np.integer)):
+        return b"\x00" + _I64.pack(int(key))
+    kb = str(key).encode()
+    if len(kb) > 0xFFFF:
+        raise MXNetError("key too long")
+    return b"\x01" + struct.pack("!H", len(kb)) + kb
+
+
+def _unpack_key(buf: memoryview, off: int):
+    kind = buf[off]
+    off += 1
+    if kind == 0:
+        (k,) = _I64.unpack_from(buf, off)
+        return int(k), off + 8
+    (n,) = struct.unpack_from("!H", buf, off)
+    off += 2
+    return bytes(buf[off:off + n]).decode(), off + n
+
+
+def _pack_tensor(arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    dt = arr.dtype.str.encode()  # e.g. b'<f4' — unambiguous, endian-tagged
+    if arr.ndim > 0xFF or len(dt) > 0xFF:
+        raise MXNetError("tensor rank/dtype out of protocol range")
+    head = struct.pack("!B", len(dt)) + dt + struct.pack("!B", arr.ndim)
+    head += struct.pack(f"!{arr.ndim}I", *arr.shape) if arr.ndim else b""
+    return head + arr.tobytes()
+
+
+def _unpack_tensor(buf: memoryview, off: int) -> Tuple[np.ndarray, int]:
+    dlen = buf[off]
+    off += 1
+    dt = np.dtype(bytes(buf[off:off + dlen]).decode())
+    off += dlen
+    ndim = buf[off]
+    off += 1
+    shape = struct.unpack_from(f"!{ndim}I", buf, off) if ndim else ()
+    off += 4 * ndim
+    n = int(np.prod(shape)) if shape else 1
+    nbytes = n * dt.itemsize
+    arr = np.frombuffer(buf[off:off + nbytes], dtype=dt).reshape(shape)
+    return arr, off + nbytes
+
+
+def _send_frame(sock: socket.socket, body: bytes) -> None:
+    sock.sendall(_U32.pack(len(body)) + body)
+
+
+def _recv_frame(sock: socket.socket) -> memoryview:
+    hdr = _recv_exact(sock, _U32.size)
+    (n,) = _U32.unpack(hdr)
+    return memoryview(_recv_exact(sock, n))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
         if not chunk:
             raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def _err_body(msg: str) -> bytes:
+    mb = msg.encode()[:0xFFFF]
+    return b"\x01" + struct.pack("!H", len(mb)) + mb
+
+
+def _body_init(key, value) -> bytes:
+    return bytes([_INIT]) + _pack_key(key) + _pack_tensor(np.asarray(value))
+
+
+def _body_push(key, grad, sync: bool) -> bytes:
+    return (bytes([_PUSH_SYNC if sync else _PUSH]) + _pack_key(key)
+            + _pack_tensor(np.asarray(grad)))
+
+
+def _body_pull(key, min_round: int) -> bytes:
+    return bytes([_PULL]) + _pack_key(key) + _U64.pack(min_round)
+
+
+# ---------------------------------------------------------------------------
 
 
 class ParameterServer:
-    """Rank-0 server: stores weights, applies pushes on arrival."""
+    """One shard: stores weights, applies pushes.
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    ``sync=False`` (async): every push is applied on arrival
+    (update-on-arrival, reference kvstore_dist_server.h:199-207).
+    ``sync=True``: pushes accumulate; when ``num_workers`` pushes for a
+    key have arrived the updater runs ONCE on the sum and the round
+    counter advances — pulls can wait for a round (BSP semantics,
+    reference kvstore_dist_server.h:136-198)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 secret: bytes = b"", num_workers: int = 1,
+                 sync: bool = False):
         self._store: Dict[Any, np.ndarray] = {}
-        # per-key count of applied pushes — doubles as the version
-        # returned by pull (each applied push is one version bump)
-        self._applied: Dict[Any, int] = {}
+        self._applied: Dict[Any, int] = {}   # pushes applied (version)
+        self._round: Dict[Any, int] = {}     # completed update rounds
+        self._pending: Dict[Any, np.ndarray] = {}
+        self._pending_n: Dict[Any, int] = {}
         self._updater = None
-        self._lock = threading.Lock()
+        self._secret = secret
+        self._num_workers = num_workers
+        self._sync = sync
+        self._cond = threading.Condition()
         server_self = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
                     while True:
-                        req = _recv_msg(self.request)
-                        _send_msg(self.request, server_self._dispatch(req))
+                        req = _recv_frame(self.request)
+                        _send_frame(self.request, server_self._dispatch(req))
                 except (ConnectionError, EOFError, OSError):
                     pass
 
@@ -89,129 +223,210 @@ class ParameterServer:
             name="mxnet_tpu-ps")
         self._thread.start()
 
-    # -- request dispatch (all under the store lock: arrival order) ----
-    def _dispatch(self, req):
-        op = req[0]
+    # -- request dispatch ----------------------------------------------
+    def _dispatch(self, buf: memoryview) -> bytes:
         try:
-            with self._lock:
-                if op == "init":
-                    _, key, value = req
-                    # first init wins; later inits are no-ops (every
-                    # worker calls init — reference server keeps the
-                    # first arrival's value)
+            op = buf[0]
+            off = 1
+            if op == _INIT:
+                key, off = _unpack_key(buf, off)
+                value, _ = _unpack_tensor(buf, off)
+                with self._cond:
+                    # first init wins; later inits are no-ops (the
+                    # reference server keeps the first arrival's value)
                     if key not in self._store:
                         self._store[key] = np.array(value, copy=True)
                         self._applied[key] = 0
-                    return ("ok",)
-                if op == "push":
-                    _, key, grad = req
+                        self._round[key] = 0
+                return b"\x00"
+            if op in (_PUSH, _PUSH_SYNC):
+                key, off = _unpack_key(buf, off)
+                grad, _ = _unpack_tensor(buf, off)
+                with self._cond:
                     if key not in self._store:
                         raise MXNetError(f"push to uninitialized key {key}")
-                    stored = self._store[key]
-                    if self._updater is not None:
-                        # update-on-arrival: exactly the reference async
-                        # branch (kvstore_dist_server.h:199-207)
-                        from .ndarray import NDArray
-                        import jax.numpy as jnp
-
-                        w = NDArray(jnp.asarray(stored))
-                        self._updater(key, NDArray(jnp.asarray(grad)), w)
-                        self._store[key] = np.asarray(w.asnumpy(),
-                                                      dtype=stored.dtype)
+                    if op == _PUSH and not self._sync:
+                        self._apply(key, grad)
                     else:
-                        self._store[key] = np.asarray(grad,
-                                                      dtype=stored.dtype)
-                    self._applied[key] += 1
-                    return ("ok",)
-                if op == "pull":
-                    _, key = req
+                        # sync: merge; apply once all workers pushed
+                        if key in self._pending:
+                            self._pending[key] = self._pending[key] + grad
+                            self._pending_n[key] += 1
+                        else:
+                            self._pending[key] = np.array(
+                                grad, dtype=np.float64
+                                if grad.dtype == np.float64 else np.float32)
+                            self._pending_n[key] = 1
+                        if self._pending_n[key] >= self._num_workers:
+                            self._apply(key, self._pending.pop(key))
+                            del self._pending_n[key]
+                return b"\x00"
+            if op == _PULL:
+                key, off = _unpack_key(buf, off)
+                (min_round,) = _U64.unpack_from(buf, off)
+                with self._cond:
                     if key not in self._store:
                         raise MXNetError(f"pull from uninitialized key {key}")
-                    return ("ok", self._store[key], self._applied[key])
-                if op == "set_optimizer":
-                    _, blob = req
-                    from . import optimizer as opt
+                    # BSP wait: block until the requested round completed
+                    ok = self._cond.wait_for(
+                        lambda: self._round.get(key, 0) >= min_round,
+                        timeout=600.0)
+                    if not ok:
+                        raise MXNetError(
+                            f"pull({key}) timed out waiting for round "
+                            f"{min_round} (stuck worker?)")
+                    body = (b"\x00" + _U64.pack(self._round[key])
+                            + _pack_tensor(self._store[key]))
+                return body
+            if op == _NUM_APPLIED:
+                key, _ = _unpack_key(buf, off)
+                with self._cond:
+                    return b"\x00" + _U64.pack(self._applied.get(key, 0))
+            if op == _SET_OPT:
+                (blen,) = _U32.unpack_from(buf, off)
+                off += 4
+                blob = bytes(buf[off:off + blen])
+                off += blen
+                mac = bytes(buf[off:off + 32])
+                if not self._secret:
+                    # an empty key would make the MAC computable by
+                    # anyone who can reach the port — the exact RCE
+                    # surface this protocol exists to close
+                    raise MXNetError(
+                        "server has no HMAC secret — set_optimizer "
+                        "refused (construct ParameterServer with the "
+                        "launcher-distributed secret)")
+                want = hmac.new(self._secret, blob, hashlib.sha256).digest()
+                if not hmac.compare_digest(mac, want):
+                    raise MXNetError(
+                        "optimizer blob failed HMAC verification — "
+                        "refusing to unpickle")
+                from . import optimizer as opt
 
-                    # first installation wins: every rank's Module calls
-                    # set_optimizer; replacing a live updater would
-                    # silently reset momentum/lr-schedule state for
-                    # pushes already applied
+                with self._cond:
+                    # first installation wins: replacing a live updater
+                    # would reset momentum state mid-training
                     if self._updater is None:
                         self._updater = opt.get_updater(pickle.loads(blob))
-                    return ("ok",)
-                if op == "num_applied":
-                    _, key = req
-                    return ("ok", self._applied.get(key, 0))
-                if op == "stop":
-                    threading.Thread(target=self._server.shutdown,
-                                     daemon=True).start()
-                    return ("ok",)
-            raise MXNetError(f"unknown ps op {op!r}")
+                return b"\x00"
+            if op == _STOP:
+                threading.Thread(target=self._server.shutdown,
+                                 daemon=True).start()
+                return b"\x00"
+            raise MXNetError(f"unknown ps op {op}")
         except Exception as e:  # noqa: BLE001 — ANY server-side failure
-            # must travel back to the pushing worker as ('err', ...);
-            # letting e.g. a shape-mismatch ValueError escape would kill
-            # the handler thread silently and the worker would only see
-            # an unexplained ConnectionError
-            return ("err", f"{type(e).__name__}: {e}")
+            # must travel back to the worker as an error frame; letting
+            # it escape would kill the handler thread silently
+            return _err_body(f"{type(e).__name__}: {e}")
+
+    def _apply(self, key, grad: np.ndarray) -> None:
+        """Run the updater (or plain assign) — caller holds the lock."""
+        stored = self._store[key]
+        if self._updater is not None:
+            from .ndarray import NDArray
+            import jax.numpy as jnp
+
+            w = NDArray(jnp.asarray(stored))
+            self._updater(key, NDArray(jnp.asarray(
+                np.asarray(grad, dtype=stored.dtype))), w)
+            self._store[key] = np.asarray(w.asnumpy(), dtype=stored.dtype)
+        else:
+            self._store[key] = np.asarray(grad, dtype=stored.dtype)
+        self._applied[key] += 1
+        self._round[key] += 1
+        # async-mode pulls may also wait on a round (min_round > 0) —
+        # without this they'd sleep out the full wait_for timeout
+        self._cond.notify_all()
 
     def close(self):
         self._server.shutdown()
         self._server.server_close()
 
 
-class PSClient:
-    """One persistent connection per process (thread-safe)."""
+# ---------------------------------------------------------------------------
 
-    def __init__(self, host: str, port: int, timeout: float = 60.0):
+
+class PSClient:
+    """One persistent connection to one server shard (thread-safe)."""
+
+    def __init__(self, host: str, port: int, secret: bytes = b"",
+                 timeout: float = 60.0):
         self._addr = (host, port)
+        self._secret = secret
         self._lock = threading.Lock()
-        deadline = timeout
         import time
 
         t0 = time.time()
         while True:
             try:
                 self._sock = socket.create_connection(self._addr, timeout=10)
-                # widen the timeout after connecting: the server
-                # serializes requests under one lock so responses can
-                # queue for a long time, and a short recv timeout would
-                # desync the length-prefixed protocol — but keep a
-                # generous ceiling so a dead rank-0 host surfaces as an
-                # error instead of hanging workers forever
-                self._sock.settimeout(600.0)
+                # widen after connect: sync pulls legitimately block for
+                # a whole round; keep a ceiling so a dead server surfaces
+                self._sock.settimeout(630.0)
                 break
             except OSError:
-                if time.time() - t0 > deadline:
+                if time.time() - t0 > timeout:
                     raise MXNetError(
                         f"cannot reach parameter server at {self._addr}")
                 time.sleep(0.2)
 
-    def _call(self, *req):
-        with self._lock:
-            _send_msg(self._sock, req)
-            resp = _recv_msg(self._sock)
-        if resp[0] == "err":
-            raise MXNetError(f"parameter server: {resp[1]}")
-        return resp
+    def _begin(self, body: bytes):
+        """Send now, collect later: lets ShardedPSClient pipeline one
+        request per shard (send all, then receive all) instead of S
+        serialized round-trips.  The lock is held until the matching
+        ``finish()`` runs — callers must pair every _begin with its
+        finish, and never _begin twice on one client before finishing
+        (ShardedPSClient plans touch each shard at most once per op)."""
+        self._lock.acquire()
+        try:
+            _send_frame(self._sock, body)
+        except BaseException:
+            self._lock.release()
+            raise
+
+        def finish() -> memoryview:
+            try:
+                resp = _recv_frame(self._sock)
+            finally:
+                self._lock.release()
+            if resp[0] != 0:
+                (n,) = struct.unpack_from("!H", resp, 1)
+                raise MXNetError(
+                    f"parameter server: {bytes(resp[3:3 + n]).decode()}")
+            return resp
+
+        return finish
+
+    def _call(self, body: bytes) -> memoryview:
+        return self._begin(body)()
 
     def init(self, key, value: np.ndarray):
-        self._call("init", key, np.asarray(value))
+        self._call(_body_init(key, value))
 
     def push(self, key, grad: np.ndarray):
-        self._call("push", key, np.asarray(grad))
+        self._call(_body_push(key, grad, sync=False))
 
-    def pull(self, key) -> np.ndarray:
-        return self._call("pull", key)[1]
+    def push_sync(self, key, grad: np.ndarray):
+        self._call(_body_push(key, grad, sync=True))
+
+    def pull(self, key, min_round: int = 0) -> np.ndarray:
+        resp = self._call(_body_pull(key, min_round))
+        arr, _ = _unpack_tensor(resp, 1 + 8)
+        return np.array(arr)  # own the buffer (resp view dies here)
 
     def set_optimizer(self, optimizer):
-        self._call("set_optimizer", pickle.dumps(optimizer))
+        blob = pickle.dumps(optimizer)
+        mac = hmac.new(self._secret, blob, hashlib.sha256).digest()
+        self._call(bytes([_SET_OPT]) + _U32.pack(len(blob)) + blob + mac)
 
     def num_applied(self, key) -> int:
-        return self._call("num_applied", key)[1]
+        resp = self._call(bytes([_NUM_APPLIED]) + _pack_key(key))
+        (n,) = _U64.unpack_from(resp, 1)
+        return int(n)
 
     def stop(self):
         try:
-            self._call("stop")
+            self._call(bytes([_STOP]))
         except Exception:
             pass
 
@@ -220,3 +435,105 @@ class PSClient:
             self._sock.close()
         except OSError:
             pass
+
+
+class ShardedPSClient:
+    """Worker-side facade over S server shards: small keys hash to one
+    shard, big arrays split flat across all shards (the reference's
+    EncodeKey scheme, kvstore_dist.h:264-302)."""
+
+    def __init__(self, addrs: Sequence[Tuple[str, int]],
+                 secret: bytes = b"", big_bound: Optional[int] = None):
+        self.clients = [PSClient(h, p, secret) for h, p in addrs]
+        self.big_bound = bigarray_bound() if big_bound is None else big_bound
+        # key → total flat size, recorded at init: num_applied and
+        # shape-less pulls must plan the same split init/push used
+        self._sizes: Dict[Any, int] = {}
+
+    @property
+    def num_servers(self) -> int:
+        return len(self.clients)
+
+    def _plan(self, key, size: int):
+        """→ list of (client, wire_key, flat_start, flat_stop); one
+        entry for small keys, one per shard for big arrays."""
+        S = self.num_servers
+        if size < self.big_bound or S == 1:
+            return [(self.clients[server_of(key, S)], key, 0, size)]
+        parts = []
+        start = 0
+        for i, n in enumerate(split_sizes(size, S)):
+            if n > 0:
+                parts.append((self.clients[i], f"{key}\x00part{i}",
+                              start, start + n))
+            start += n
+        return parts
+
+    @staticmethod
+    def _fan_out(calls):
+        """Pipeline one request per shard: send everything, then
+        collect — S overlapped round-trips instead of S serialized
+        ones.  Safe because a plan touches each client at most once
+        (a second _begin on the same client would self-deadlock)."""
+        finishers = [(cl._begin(body), extra) for cl, body, extra in calls]
+        return [(fin(), extra) for fin, extra in finishers]
+
+    def init(self, key, value: np.ndarray):
+        value = np.asarray(value)
+        self._sizes[key] = value.size
+        flat = value.reshape(-1)
+        self._fan_out([
+            (cl, _body_init(wk, flat[a:b] if (a, b) != (0, value.size)
+                            else value), None)
+            for cl, wk, a, b in self._plan(key, value.size)])
+
+    def _push(self, key, grad: np.ndarray, sync: bool):
+        grad = np.asarray(grad)
+        flat = grad.reshape(-1)
+        self._fan_out([
+            (cl, _body_push(wk, flat[a:b] if (a, b) != (0, grad.size)
+                            else grad, sync), None)
+            for cl, wk, a, b in self._plan(key, grad.size)])
+
+    def push(self, key, grad: np.ndarray):
+        self._push(key, grad, sync=False)
+
+    def push_sync(self, key, grad: np.ndarray):
+        self._push(key, grad, sync=True)
+
+    def pull(self, key, shape=None, dtype=None, min_round: int = 0):
+        size = (int(np.prod(shape)) if shape is not None
+                else self._sizes.get(key, 0))
+        plan = self._plan(key, size)
+        if len(plan) == 1:
+            return plan[0][0].pull(plan[0][1], min_round)
+        if shape is None:
+            raise MXNetError("pull of a split key needs the shape")
+        out = np.empty(size, dtype=np.dtype(dtype) if dtype else np.float32)
+        for resp, (a, b) in self._fan_out([
+                (cl, _body_pull(wk, min_round), (a, b))
+                for cl, wk, a, b in plan]):
+            arr, _ = _unpack_tensor(resp, 1 + 8)
+            out[a:b] = arr.reshape(-1)
+        return out.reshape(shape)
+
+    def set_optimizer(self, optimizer):
+        blob = pickle.dumps(optimizer)
+        self._fan_out([
+            (cl, bytes([_SET_OPT]) + _U32.pack(len(blob)) + blob
+             + hmac.new(cl._secret, blob, hashlib.sha256).digest(), None)
+            for cl in self.clients])
+
+    def num_applied(self, key, size: Optional[int] = None) -> int:
+        if size is None:
+            size = self._sizes.get(key, 0)
+        plan = self._plan(key, size)
+        return min(cl.num_applied(wk) for cl, wk, _, _ in plan)
+
+    def stop(self):
+        for cl in self.clients:
+            cl.stop()
+
+    def close(self):
+        for cl in self.clients:
+            cl.close()

@@ -64,6 +64,27 @@ def main():
                                rtol=1e-6)
     applied = kv._ps.num_applied("w")
     assert applied == total, f"server applied {applied} != {total} pushes"
+
+    # --- big-array path: split flat across ALL server shards ----------
+    # (reference: kvstore_dist.h:286-296 partition + the nightly
+    # dist_sync_kvstore.py big_shape check).  The launching test sets
+    # MXNET_KVSTORE_BIGARRAY_BOUND small so BIG_SHAPE splits.
+    BIG_SHAPE = (120, 120)
+    big = np.arange(np.prod(BIG_SHAPE), dtype=np.float32).reshape(BIG_SHAPE)
+    if int(os.environ.get("MXNET_KVSTORE_BIGARRAY_BOUND", "1000000")) \
+            < big.size:
+        assert len(kv._ps._plan("big", big.size)) == nw, \
+            "big key must split across every server shard"
+    kv.init("big", mx.nd.zeros(BIG_SHAPE))
+    kv.barrier()
+    # the server-side SGD updater is store-wide: each worker's push of
+    # `big` lands as one -LR*big step on the zero-initialized weight
+    kv.push("big", mx.nd.array(big))
+    kv.barrier()
+    out = mx.nd.zeros(BIG_SHAPE)
+    kv.pull("big", out=out)
+    np.testing.assert_allclose(out.asnumpy(), -LR * nw * big, rtol=1e-6)
+
     kv.barrier()
     print(f"worker {rank}/{nw}: dist_async update-on-arrival OK "
           f"({pushes} pushes, {total} applied)", flush=True)

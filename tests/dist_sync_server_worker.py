@@ -1,0 +1,47 @@
+"""Worker for the server-side-update dist_sync proof.
+
+Launched by ``tools/launch.py -n 2 --cpu python
+tests/dist_sync_server_worker.py <out>`` with
+``MXNET_KVSTORE_SYNC_ON_SERVER=1`` and a small
+``MXNET_KVSTORE_BIGARRAY_BOUND`` (so the FC weights exercise the
+split-key path too): the optimizer runs ON the server shards after
+NumWorkers pushes, workers stay stateless, and each pull waits for the
+round (the reference's dist_sync architecture,
+``kvstore_dist_server.h:136-219`` + pickled-optimizer
+``python/mxnet/kvstore.py:232-252``).
+
+tests/test_dist.py::test_launch_module_fit_dist_sync_on_server asserts
+the final weights equal the replicated-updater single-process run —
+same check as the plain dist_sync test, different update architecture.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+import dist_module_worker as W
+
+
+def main():
+    assert os.environ.get("MXNET_KVSTORE_SYNC_ON_SERVER") == "1"
+    out_path = sys.argv[1]
+    kv = mx.kv.create("dist_sync")
+    assert kv._server_sync and kv._ps is not None
+    assert kv._updater is None, "workers must be stateless in server mode"
+    rank, nw = kv.rank, kv.num_workers
+    X, y = W.make_data()
+    Xs, ys = W.shard(X, y, rank, nw)
+    params = W.train(Xs, ys, W.GLOBAL_BATCH // nw, kv)
+    assert kv._updater is None, "optimizer must have stayed server-side"
+    np.savez(out_path + f".rank{rank}", **params)
+    kv.barrier()
+    print(f"worker {rank}/{nw}: module fit dist_sync on-server OK",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -166,16 +166,57 @@ def test_launch_module_fit_tpu_mesh(tmp_path):
                                    err_msg=f"mesh != single for {k}")
 
 
+def test_launch_module_fit_dist_sync_on_server(tmp_path):
+    """Server-side sync updates (MXNET_KVSTORE_SYNC_ON_SERVER=1): the
+    optimizer runs on the sharded servers once NumWorkers pushes arrive,
+    workers stateless, pulls wait for the round; FC weights exceed the
+    (lowered) big-array bound so split keys are exercised in training.
+    Final weights must equal the replicated-path single-process run
+    (reference: kvstore_dist_server.h:136-219)."""
+    import numpy as np
+
+    out = str(tmp_path / "srv_params")
+    env = _worker_env()
+    env["MXNET_KVSTORE_SYNC_ON_SERVER"] = "1"
+    env["MXNET_KVSTORE_BIGARRAY_BOUND"] = "1000"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--cpu",
+         sys.executable,
+         os.path.join(REPO, "tests", "dist_sync_server_worker.py"), out],
+        capture_output=True, text=True, timeout=900, cwd=REPO, env=env)
+    o = r.stdout + r.stderr
+    assert r.returncode == 0, o
+    assert "worker 0/2: module fit dist_sync on-server OK" in o
+    assert "worker 1/2: module fit dist_sync on-server OK" in o
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import dist_module_worker as W
+    X, y = W.make_data()
+    single = W.train(X, y, W.GLOBAL_BATCH, kvstore=None)
+
+    d0 = dict(np.load(out + ".rank0.npz"))
+    d1 = dict(np.load(out + ".rank1.npz"))
+    assert set(d0) == set(single)
+    for k in single:
+        np.testing.assert_allclose(d0[k], d1[k], rtol=1e-6, atol=1e-7,
+                                   err_msg=f"worker disagreement on {k}")
+        np.testing.assert_allclose(d0[k], single[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=f"server-sync != single for {k}")
+
+
 def test_launch_two_process_dist_async():
     """Real async consistency: unequal push rates, pulls without
     rendezvous, every push applied on arrival (reference:
     kvstore_dist_server.h:199-207)."""
+    env = _worker_env()
+    env["MXNET_KVSTORE_BIGARRAY_BOUND"] = "5000"  # (120,120) must split
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", "2", "--cpu",
          sys.executable, os.path.join(REPO, "tests", "dist_async_worker.py")],
         capture_output=True, text=True, timeout=600, cwd=REPO,
-        env=_worker_env())
+        env=env)
     out = r.stdout + r.stderr
     assert r.returncode == 0, out
     assert "worker 0/2: dist_async update-on-arrival OK" in out
