@@ -45,6 +45,7 @@ from . import model
 from . import module
 from . import module as mod
 from . import operator
+from . import rtc
 from . import predictor
 from .predictor import Predictor
 from . import sequence

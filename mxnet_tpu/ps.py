@@ -474,9 +474,32 @@ class ShardedPSClient:
         """Pipeline one request per shard: send everything, then
         collect — S overlapped round-trips instead of S serialized
         ones.  Safe because a plan touches each client at most once
-        (a second _begin on the same client would self-deadlock)."""
-        finishers = [(cl._begin(body), extra) for cl, body, extra in calls]
-        return [(fin(), extra) for fin, extra in finishers]
+        (a second _begin on the same client would self-deadlock).
+        EVERY finisher runs even when one raises: an abandoned finisher
+        would leave its client lock held and its response undrained,
+        deadlocking the next op on that shard."""
+        finishers = []
+        try:
+            for cl, body, extra in calls:
+                finishers.append((cl._begin(body), extra))
+        except BaseException:
+            for fin, _ in finishers:
+                try:
+                    fin()
+                except Exception:
+                    pass
+            raise
+        results = []
+        first_err = None
+        for fin, extra in finishers:
+            try:
+                results.append((fin(), extra))
+            except Exception as e:  # noqa: BLE001 — drain them all
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return results
 
     def init(self, key, value: np.ndarray):
         value = np.asarray(value)

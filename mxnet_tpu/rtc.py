@@ -1,0 +1,202 @@
+"""User-authored kernels as first-class operators — the RTC surface.
+
+The reference lets users write kernel source from Python and launch it
+on NDArrays (``python/mxnet/rtc.py`` Rtc: CUDA body text →
+``src/common/mxrtc.cc:13-76`` NVRTC compile + launch).  The TPU-native
+equivalent of "user supplies the kernel from Python" is a **Pallas**
+kernel: the user writes the ref-style kernel function (or any jax-level
+function wrapping ``pl.pallas_call``), registers it under a name, and
+the framework exposes it everywhere a built-in op appears —
+
+* imperatively: ``mx.nd.<name>(x, y)``;
+* symbolically: ``mx.sym.<name>(a, b)`` composing into graphs that
+  bind/forward/backward through the one fused XLA program;
+* differentiably: an optional user VJP (itself free to be a Pallas
+  kernel) is installed via ``jax.custom_vjp``; without one, XLA
+  differentiates through the kernel only if it is built from
+  differentiable jax ops (``register_op``), while raw Pallas kernels
+  (``pallas_op``) need the explicit VJP to train.
+
+Worked example: ``examples/user_pallas_kernel.py``; tests:
+``tests/test_rtc.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .base import MXNetError
+from .ops import registry as _reg
+
+__all__ = ["register_op", "pallas_op"]
+
+
+def _expose(name: str) -> None:
+    """Make the freshly-registered op callable as mx.nd.<name> and
+    mx.sym.<name> (registration-time autogen runs at import; late
+    registrations attach here)."""
+    from . import ndarray as _nd
+    from . import symbol as _sym
+
+    setattr(_nd, name, _nd._make_ndarray_function(name))
+    setattr(_sym, name, _sym._make_symbol_function(name))
+
+
+def register_op(name: str,
+                fn: Callable,
+                arg_names: Sequence[str] = ("data",),
+                infer_shape: Optional[Callable] = None,
+                vjp: Optional[Callable] = None,
+                doc: str = ""):
+    """Register a user jax-level function as a named operator.
+
+    Parameters
+    ----------
+    name : str
+        Operator name; becomes ``mx.nd.<name>`` / ``mx.sym.<name>``.
+        Must not collide with a built-in op.
+    fn : callable
+        ``fn(*inputs) -> output`` (or tuple of outputs) on jax arrays.
+        Runs inside jit — traceable jax code only (this includes
+        ``pl.pallas_call``).
+    arg_names : sequence of str
+        Formal input names (symbol composition / auto-Variable rules).
+    infer_shape : callable, optional
+        ``infer_shape(*in_shapes) -> out_shape | [out_shapes]``.
+        Defaults to "same shape as first input".
+    vjp : callable, optional
+        ``vjp(inputs, out_grads) -> input_grads`` where ``inputs`` and
+        ``out_grads`` are tuples; recompute what you need from the
+        inputs (rematerialization — the TPU-first default — rather than
+        stashing activations).  Installed via ``jax.custom_vjp``.
+    doc : str
+        Docstring for the generated functions.
+    """
+    if name in _reg._OPS:
+        raise MXNetError(f"operator {name!r} already registered")
+    from . import ndarray as _nd
+    from . import symbol as _sym
+
+    if hasattr(_nd, name) or hasattr(_sym, name):
+        # would clobber a module-level API function (zeros, array,
+        # Variable, ...) via _expose's setattr
+        raise MXNetError(
+            f"{name!r} collides with an existing mx.nd/mx.sym API name")
+    if vjp is not None:
+        user_fn = fn
+
+        @jax.custom_vjp
+        def wrapped(*inputs):
+            return user_fn(*inputs)
+
+        def fwd(*inputs):
+            return user_fn(*inputs), inputs
+
+        def bwd(saved, g):
+            gs = vjp(saved, g if isinstance(g, tuple) else (g,))
+            if not isinstance(gs, (list, tuple)):
+                gs = (gs,)
+            if len(gs) != len(saved):
+                raise MXNetError(
+                    f"vjp for {name!r} returned {len(gs)} gradients for "
+                    f"{len(saved)} inputs")
+            return tuple(gs)
+
+        wrapped.defvjp(fwd, bwd)
+        compute_fn = wrapped
+    else:
+        compute_fn = fn
+
+    n_args = len(arg_names)
+
+    def compute(op_ctx, attrs, inputs, aux):
+        if len(inputs) != n_args:
+            raise MXNetError(
+                f"{name} expects {n_args} inputs ({list(arg_names)}), "
+                f"got {len(inputs)}")
+        out = compute_fn(*inputs)
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+
+    def shape_infer(attrs, in_shapes):
+        if infer_shape is None:
+            outs = [in_shapes[0]]
+        else:
+            if any(s is None for s in in_shapes):
+                return in_shapes, [None], []
+            out = infer_shape(*in_shapes)
+            outs = list(out) if out and isinstance(out[0], (list, tuple)) \
+                else [tuple(out)]
+            outs = [tuple(o) for o in outs]
+        return in_shapes, outs, []
+
+    _reg.register(name, arg_names=tuple(arg_names), doc=doc or
+                  f"user-registered kernel op (mxnet_tpu.rtc) — "
+                  f"reference capability: python/mxnet/rtc.py")(compute)
+    _reg.get_op(name).infer_shape = shape_infer
+    _expose(name)
+    return _reg.get_op(name)
+
+
+def pallas_op(name: str,
+              kernel: Callable,
+              arg_names: Sequence[str] = ("data",),
+              out_like: int | Callable = 0,
+              grid=None,
+              in_specs=None,
+              out_specs=None,
+              vjp: Optional[Callable] = None,
+              infer_shape: Optional[Callable] = None,
+              interpret: Optional[bool] = None,
+              doc: str = ""):
+    """Register a raw Pallas kernel as a named operator.
+
+    The kernel has the standard Pallas signature
+    ``kernel(*in_refs, out_ref)`` (or multiple out refs when
+    ``out_like`` returns a tuple).  Without ``grid``/specs the kernel
+    sees whole-array refs in VMEM — the right default for fused
+    elementwise/small-block kernels; heavy tiled kernels pass their own
+    ``grid``/``in_specs``/``out_specs`` straight through to
+    ``pl.pallas_call``.
+
+    ``out_like``: index of the input whose shape/dtype the output
+    mirrors, or ``fn(*inputs) -> jax.ShapeDtypeStruct`` (or tuple).
+    ``interpret``: force Pallas interpret mode; default auto — native
+    on TPU, interpreter elsewhere (CPU tests).
+    """
+    from jax.experimental import pallas as pl
+
+    from .ops import pallas_kernels as _pk
+
+    def fn(*inputs):
+        if callable(out_like):
+            shape = out_like(*inputs)
+        else:
+            x = inputs[out_like]
+            shape = jax.ShapeDtypeStruct(x.shape, x.dtype)
+        kw = {}
+        if grid is not None:
+            kw["grid"] = grid
+        if in_specs is not None:
+            kw["in_specs"] = in_specs
+        if out_specs is not None:
+            kw["out_specs"] = out_specs
+        if interpret is None:
+            # native only when the computation actually lands on a TPU:
+            # the backend must be tpu AND the active context must be the
+            # chip (a cpu-context run on a TPU host traces for the CPU
+            # device, where native Pallas lowering is unavailable)
+            from .context import current_context
+
+            run_interp = (_pk._interpret()
+                          or current_context().device_type != "tpu")
+        else:
+            run_interp = interpret
+        return pl.pallas_call(kernel, out_shape=shape,
+                              interpret=run_interp, **kw)(*inputs)
+
+    return register_op(name, fn, arg_names=arg_names, vjp=vjp,
+                       infer_shape=infer_shape, doc=doc or
+                       f"user Pallas kernel op (mxnet_tpu.rtc.pallas_op)")
