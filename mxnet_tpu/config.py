@@ -103,6 +103,18 @@ register_env(
     "MXNET_KVSTORE_HEARTBEAT_INTERVAL", 1.0, float,
     "Seconds between heartbeat file touches.")
 register_env(
+    "MXNET_KVSTORE_BIGARRAY_BOUND", 1000 * 1000, int,
+    "Element count above which a dist-kvstore array is split flat "
+    "across ALL parameter-server shards instead of living whole on "
+    "one hashed shard (reference: comm.h:65, kvstore_dist.h:286-296).")
+register_env(
+    "MXNET_KVSTORE_SYNC_ON_SERVER", 0, int,
+    "dist_sync architecture switch: 1 runs the optimizer ON the "
+    "sharded parameter servers after NumWorkers pushes (workers "
+    "stateless, pulls wait for the round — the reference's "
+    "kvstore_dist_server.h:136-219 design); 0 (default) keeps the "
+    "replicated-updater allgather-sum path.")
+register_env(
     "MXNET_TEST_DEVICE", None, str,
     "Device the test utilities bind to (test_utils.default_context; "
     "the reference's MXNET_TEST_DEVICE).  Unset: the ambient current "

@@ -19,23 +19,16 @@ from .. import symbol as sym
 
 def _block(x, d_model, num_heads, d_ff, name, causal, dropout,
            block_size):
-    head_dim = d_model // num_heads
-    # attention sublayer (pre-LN)
+    # attention sublayer (pre-LN).  The fused QKV projection output
+    # feeds QKVSelfAttention DIRECTLY — the packed-heads Pallas kernel
+    # slices heads by lane span, so no reshape/slice/transpose ops
+    # exist between the two matmuls (they measured ~20 ms/step at
+    # GPT-2-small scale; tools/profile_transformer.py, PERF.md)
     h = sym.LayerNorm(x, name=f"{name}_ln1")
     qkv = sym.FullyConnected(h, num_hidden=3 * d_model, flatten=False,
                              name=f"{name}_qkv")
-    qkv = sym.Reshape(qkv, shape=(0, 0, 3, num_heads, head_dim),
-                      name=f"{name}_qkv_split")
-    q = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=0, end=1),
-                    shape=(0, 0, -3, 0), name=f"{name}_q")
-    k = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=1, end=2),
-                    shape=(0, 0, -3, 0), name=f"{name}_k")
-    v = sym.Reshape(sym.slice_axis(qkv, axis=2, begin=2, end=3),
-                    shape=(0, 0, -3, 0), name=f"{name}_v")
-    att = sym.DotProductAttention(q, k, v, causal=causal,
-                                  block_size=block_size,
-                                  name=f"{name}_attn")
-    att = sym.Reshape(att, shape=(0, 0, -3), name=f"{name}_attn_merge")
+    att = sym.QKVSelfAttention(qkv, num_heads=num_heads, causal=causal,
+                               block_size=block_size, name=f"{name}_attn")
     att = sym.FullyConnected(att, num_hidden=d_model, flatten=False,
                              name=f"{name}_proj")
     if dropout > 0:
@@ -55,8 +48,11 @@ def _block(x, d_model, num_heads, d_ff, name, causal, dropout,
 
 def transformer_lm(vocab_size, seq_len, num_layers=4, num_heads=4,
                    d_model=128, d_ff=None, causal=True, dropout=0.0,
-                   block_size=512, dtype="float32"):
-    """Token ids (B, T) -> SoftmaxOutput probabilities (B, T, vocab).
+                   block_size=0, dtype="float32", head="softmax"):
+    """Token ids (B, T) -> SoftmaxOutput probabilities (B, T, vocab),
+    or per-token CE loss (B, T) with ``head="ce"`` — the fused
+    SoftmaxCELoss head never materializes the (B, T, V) probability or
+    gradient tensors, the right head for 32k+ vocabularies (PERF.md).
 
     Labels are next-token ids (B, T); padding id 0 is ignored
     (ignore_label, like the LSTM LM example).
@@ -88,6 +84,9 @@ def transformer_lm(vocab_size, seq_len, num_layers=4, num_heads=4,
     x = sym.LayerNorm(x, name="ln_f")
     logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
                                 name="head")
+    if head == "ce":
+        return sym.SoftmaxCELoss(logits, label, use_ignore=True,
+                                 ignore_label=0, name="softmax")
     return sym.SoftmaxOutput(logits, label, preserve_shape=True,
                              ignore_label=0, use_ignore=True,
                              name="softmax")

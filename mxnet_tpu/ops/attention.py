@@ -132,11 +132,44 @@ def normalize_attention_state(o, m, l, dtype):
     return out.swapaxes(1, 2).astype(dtype)
 
 
-def blockwise_attention(q, k, v, causal=False, block_size=512):
-    """Normalized blockwise attention: (B, T, H, D) → (B, T, H, D)."""
+def blockwise_attention(q, k, v, causal=False, block_size=0,
+                        layout="BTHD"):
+    """Normalized blockwise attention.
+
+    layout='BTHD': (B, T, H, D) in/out (the reference-style layout).
+    layout='BHTD': (B, H, T, D) in/out — the TPU-native layout (T in
+    the sublane slot): on the kernel path this runs with ZERO
+    transposes and no head-dim padding in HBM (the transformer model
+    emits this layout).
+    """
+    from . import pallas_kernels as pk
+
+    if pk.enabled() and q.ndim == 4:
+        # normalized kernel: in-VMEM online-softmax state, in-kernel
+        # normalization, single lse residual — ~6x less attention HBM
+        # I/O than partial+normalize for d_head=64 (PERF.md)
+        if layout == "BHTD":
+            B, H, Tq, D = q.shape
+            qf, kf, vf = (jnp.reshape(x, (B * H, x.shape[2], D))
+                          for x in (q, k, v))
+        else:
+            B, Tq, H, D = q.shape
+            qf, kf, vf = (jnp.reshape(jnp.transpose(x, (0, 2, 1, 3)),
+                                      (B * H, x.shape[1], D))
+                          for x in (q, k, v))
+        o = pk.flash_mha(qf, kf, vf, causal=causal, block_size=block_size)
+        o4 = jnp.reshape(o, (B, H, o.shape[1], D))
+        if layout == "BHTD":
+            return o4
+        return jnp.transpose(o4, (0, 2, 1, 3))
+    if layout == "BHTD":
+        q, k, v = (jnp.transpose(x, (0, 2, 1, 3)) for x in (q, k, v))
     o, m, l = blockwise_attention_partial(q, k, v, causal=causal,
-                                          block_size=block_size)
-    return normalize_attention_state(o, m, l, q.dtype)
+                                          block_size=block_size or 512)
+    out = normalize_attention_state(o, m, l, q.dtype)
+    if layout == "BHTD":
+        return jnp.transpose(out, (0, 2, 1, 3))
+    return out
 
 
 def attention_state_init(q):
@@ -169,15 +202,59 @@ def _attention_infer(attrs, in_shapes):
     return in_shapes, [tuple(q)], []
 
 
+def _qkv_infer(attrs, in_shapes):
+    (s,) = in_shapes
+    if s is None:
+        return in_shapes, None, None
+    if len(s) != 3 or s[2] % 3:
+        raise MXNetError(f"QKVSelfAttention wants (B, T, 3*d); got {s}")
+    return in_shapes, [(s[0], s[1], s[2] // 3)], []
+
+
+@register("QKVSelfAttention", arg_names=("qkv",), infer_shape=_qkv_infer,
+          doc="Self-attention straight off the fused QKV projection: "
+              "qkv (B, T, 3*H*D) packed [q|k|v] per head -> (B, T, H*D)."
+              " On TPU this is the packed-heads Pallas kernel with zero "
+              "layout changes anywhere (PERF.md); attrs: num_heads, "
+              "causal, block_size")
+def _qkv_attention(op_ctx, attrs, inputs, aux):
+    (qkv,) = inputs
+    if qkv.ndim != 3:
+        raise MXNetError("QKVSelfAttention expects (B, T, 3*H*D)")
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    causal = attr_bool(attrs.get("causal", False), False)
+    block = attr_int(attrs.get("block_size", 0), 0)
+    from . import pallas_kernels as pk
+
+    B, T, HD3 = qkv.shape
+    D = HD3 // (3 * H)
+    if pk.enabled():
+        return [pk.flash_mha_packed(qkv, H, causal=causal,
+                                    block_size=block)]
+    # lax fallback: unpack → blockwise attention → repack
+    q, k, v = (jnp.reshape(x, (B, T, H, D))
+               for x in jnp.split(qkv, 3, axis=-1))
+    o, m, l = _blockwise_attention_partial_lax(q, k, v, causal,
+                                               block or 512, 0)
+    out = normalize_attention_state(o, m, l, qkv.dtype)
+    return [jnp.reshape(out, (B, T, H * D))]
+
+
 @register("DotProductAttention", arg_names=("query", "key", "value"),
           infer_shape=_attention_infer,
           aliases=("MultiHeadAttention",),
           doc="Fused blockwise multi-head attention: (B, T, H, D) "
-              "q/k/v -> (B, T, H, D); attrs: causal, block_size")
+              "q/k/v -> (B, T, H, D); attrs: causal, block_size, "
+              "layout ('BTHD' default | 'BHTD' — the TPU-native "
+              "transpose-free layout)")
 def _attention(op_ctx, attrs, inputs, aux):
     q, k, v = inputs
     if q.ndim != 4:
-        raise MXNetError("DotProductAttention expects (B, T, H, D) inputs")
+        raise MXNetError("DotProductAttention expects 4-D inputs")
     causal = attr_bool(attrs.get("causal", False), False)
-    block = attr_int(attrs.get("block_size", 512), 512)
-    return [blockwise_attention(q, k, v, causal=causal, block_size=block)]
+    block = attr_int(attrs.get("block_size", 0), 0)
+    layout = str(attrs.get("layout", "BTHD"))
+    if layout not in ("BTHD", "BHTD"):
+        raise MXNetError(f"unknown attention layout {layout!r}")
+    return [blockwise_attention(q, k, v, causal=causal, block_size=block,
+                                layout=layout)]

@@ -753,6 +753,72 @@ def _softmax_output_infer(attrs, in_shapes):
 get_op("SoftmaxOutput").infer_shape = _softmax_output_infer
 
 
+@functools.lru_cache(maxsize=64)
+def _softmax_ce_fn(grad_scale, use_ignore, ignore_label):
+    def _loss(data, label):
+        x = data.astype(jnp.float32)
+        lse = jax.nn.logsumexp(x, axis=-1)
+        lab = label.astype(jnp.int32)
+        ll = jnp.take_along_axis(x, lab[..., None], axis=-1)[..., 0]
+        loss = lse - ll
+        if use_ignore:
+            loss = jnp.where(lab == int(ignore_label), 0.0, loss)
+        return loss, lse
+
+    @jax.custom_vjp
+    def f(data, label):
+        return _loss(data, label)[0]
+
+    def fwd(data, label):
+        loss, lse = _loss(data, label)
+        return loss, (data, lse, label)
+
+    def bwd(res, g):
+        data, lse, label = res
+        # (p − onehot)·scale from the saved LOGITS: p = exp(x − lse) is
+        # pure elementwise, so XLA fuses it into the consuming dW/dx
+        # matmul reads — the (…, V) probability and gradient tensors
+        # never materialize in HBM (the point of this head; PERF.md).
+        # Reference loss-head convention: incoming g ignored.
+        lab = label.astype(jnp.int32)
+        p = jnp.exp(data.astype(jnp.float32) - lse[..., None])
+        onehot = jax.nn.one_hot(lab, data.shape[-1], dtype=p.dtype)
+        grad = (p - onehot) * grad_scale
+        if use_ignore:
+            grad = jnp.where((lab == int(ignore_label))[..., None],
+                             0.0, grad)
+        return grad.astype(data.dtype), jnp.zeros(label.shape, label.dtype)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register("SoftmaxCELoss", arg_names=("data", "label"), is_loss=True,
+          doc="Fused softmax-cross-entropy loss head: logits (…, V) + "
+              "integer-valued labels (…) -> per-row loss (…).  Unlike "
+              "SoftmaxOutput it never materializes the (…, V) "
+              "probability or gradient tensors (backward rematerializes "
+              "p elementwise from the saved logits), which matters when "
+              "V is a 32k+ vocabulary; attrs: grad_scale, use_ignore, "
+              "ignore_label (masked rows: zero loss AND zero gradient)")
+def _softmax_ce(op_ctx, attrs, inputs, aux):
+    fn = _softmax_ce_fn(attr_float(attrs.get("grad_scale", 1.0), 1.0),
+                        attr_bool(attrs.get("use_ignore"), False),
+                        attr_float(attrs.get("ignore_label", -1.0), -1.0))
+    return [fn(inputs[0], inputs[1])]
+
+
+def _softmax_ce_infer(attrs, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes, [None], []
+    lab = tuple(d[:-1])
+    return [tuple(d), lab], [lab], []
+
+
+get_op("SoftmaxCELoss").infer_shape = _softmax_ce_infer
+
+
 def _make_regression(name, fwd_fn, grad_fn, ref):
     @functools.lru_cache(maxsize=64)
     def _fn(grad_scale):

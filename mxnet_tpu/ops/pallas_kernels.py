@@ -563,3 +563,704 @@ def flash_attention_bwd(q, k, v, m, o_bar, l_bar, causal, block_size,
     return (_unflat(dq, Tq).astype(q.dtype),
             _unflat(dk, Tk).astype(k.dtype),
             _unflat(dv, Tk).astype(v.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Normalized flash MHA — the fast path for plain (non-ring) attention.
+#
+# The partial-state kernel above serves ring attention, which must merge
+# un-normalized (o, m, l) across hops; for ordinary self-attention that
+# API costs real HBM: o leaves as f32, m and l leave as (BH, T, 128)
+# lane-broadcast f32 tensors, the normalize pass re-reads everything,
+# and the head dim is padded to 128 lanes IN HBM.  This kernel instead
+# keeps the online-softmax state in VMEM scratch across the k-block
+# grid axis, normalizes in-register at the last k-block, and writes the
+# output ONCE in the input dtype at the unpadded head dim — I/O drops
+# ~6x for d_head=64 models.  The residual saved for backward is the
+# single logsumexp tensor; the backward kernels rematerialize p from
+# (q, k, lse), the standard flash backward (ds = p ∘ (do·vT − Δ) with
+# Δ = rowsum(do ∘ o) computed outside).
+# ---------------------------------------------------------------------------
+
+
+def _mha_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                    l_ref, *, causal, block_q, block_k, tq_valid, tk_valid,
+                    scale, nk):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    if causal:
+        run = (kj * block_k) <= (qi * block_q + block_q - 1)
+        last_kj = jnp.minimum(nk - 1, (qi * block_q + block_q - 1)
+                              // block_k)
+    else:
+        run = kj >= 0
+        last_kj = nk - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < tk_valid
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid &= k_pos <= q_pos
+        s = jnp.where(valid, s, -jnp.inf)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        m_safe = jnp.where(m_new == -jnp.inf, 0.0, m_new)
+        p = jnp.where(valid, jnp.exp(s - m_safe[:, None]), 0.0)
+        alpha = jnp.where(m_prev == -jnp.inf, 0.0, jnp.exp(m_prev - m_safe))
+        l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + pv
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+
+    @pl.when(kj == last_kj)
+    def _finalize():
+        l = l_ref[:, 0]
+        m = m_ref[:, 0]
+        o_ref[0] = (acc_ref[...]
+                    / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+
+
+def _mha_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dq_ref, acc_ref, *, causal, block_q, block_k,
+                       tq_valid, tk_valid, scale, nk):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    if causal:
+        run = (kj * block_k) <= (qi * block_q + block_q - 1)
+        last_kj = jnp.minimum(nk - 1, (qi * block_q + block_q - 1)
+                              // block_k)
+    else:
+        run = kj >= 0
+        last_kj = nk - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        valid = (k_pos < tk_valid) & (q_pos < tq_valid)
+        if causal:
+            valid &= k_pos <= q_pos
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0, :, 0][:, None]), 0.0)
+        dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        ds = p * (dov - delta_ref[0, :, 0][:, None])
+        acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kj == last_kj)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _mha_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, dka_ref, dva_ref, *, causal,
+                        block_q, block_k, tq_valid, tk_valid, scale, nq):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dka_ref[...] = jnp.zeros_like(dka_ref)
+        dva_ref[...] = jnp.zeros_like(dva_ref)
+
+    if causal:
+        run = (kj * block_k) <= (qi * block_q + block_q - 1)
+    else:
+        run = qi >= 0
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        valid = (k_pos < tk_valid) & (q_pos < tq_valid)
+        if causal:
+            valid &= k_pos <= q_pos
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0, :, 0][:, None]), 0.0)
+        pT = p.astype(do.dtype)
+        dva_ref[...] += jax.lax.dot_general(
+            pT, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        ds = p * (dov - delta_ref[0, :, 0][:, None])
+        dka_ref[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dka_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dva_ref[...].astype(dv_ref.dtype)
+
+
+def _mha_block(block_size, t):
+    if int(block_size) <= 0:  # auto: larger tiles amortize the online-
+        # softmax state updates; 1024 measured fastest at T>=2048
+        # (block sweep in PERF.md), 512 below
+        block_size = 1024 if t >= 2048 else 512
+    b = max(128, min(2048, (int(block_size) // 128) * 128 or 128))
+    return min(b, max(128, ((t + 127) // 128) * 128))
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_mha_fn(causal, block_size):
+    """custom_vjp per (causal, block_size): normalized Pallas forward +
+    Pallas backward from the lse residual."""
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        o, _ = _mha_fwd(q, k, v, causal, block_size)
+        return o
+
+    def fwd(q, k, v):
+        o, lse = _mha_fwd(q, k, v, causal, block_size)
+        return o, (q, k, v, o, lse)
+
+    def bwd(res, do):
+        q, k, v, o, lse = res
+        return _mha_bwd(q, k, v, o, lse, do, causal, block_size)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def flash_mha(q, k, v, causal=False, block_size=512):
+    """Normalized flash attention: (BH, T, D) q/k/v (any D; bf16/f32)
+    → (BH, T, D) output in q.dtype.  Differentiable (custom Pallas
+    backward)."""
+    return _flash_mha_fn(bool(causal), int(block_size))(q, k, v)
+
+
+def _mha_fwd(q, k, v, causal, block_size):
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / float(D) ** 0.5
+    bq = _mha_block(block_size, Tq)
+    bk = _mha_block(block_size, Tk)
+    qf = _pad_to(q, 1, bq)
+    kf = _pad_to(k, 1, bk)
+    vf = _pad_to(v, 1, bk)
+    Tqp, Tkp = qf.shape[1], kf.shape[1]
+    nq, nk = Tqp // bq, Tkp // bk
+    kern = functools.partial(
+        _mha_fwd_kernel, causal=causal, block_q=bq, block_k=bk,
+        tq_valid=Tq, tk_valid=Tk, scale=scale, nk=nk)
+    scratch = [pltpu.VMEM((bq, D), jnp.float32),
+               pltpu.VMEM((bq, 128), jnp.float32),
+               pltpu.VMEM((bq, 128), jnp.float32)]
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(BH, nq, nk),
+        in_specs=[
+            _vmem_spec((1, bq, D), lambda bh, qi, kj: (bh, qi, 0)),
+            _vmem_spec((1, bk, D), lambda bh, qi, kj: (bh, kj, 0)),
+            _vmem_spec((1, bk, D), lambda bh, qi, kj: (bh, kj, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, bq, D), lambda bh, qi, kj: (bh, qi, 0)),
+            _vmem_spec((1, bq, 128), lambda bh, qi, kj: (bh, qi, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tqp, D), q.dtype),
+                   jax.ShapeDtypeStruct((BH, Tqp, 128), jnp.float32)],
+        scratch_shapes=scratch,
+        compiler_params=(pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024)
+            if pltpu is not None and not _interpret() else None),
+        interpret=_interpret(),
+    )(qf, kf, vf)
+    return o[:, :Tq], lse[:, :Tq]
+
+
+def _mha_bwd(q, k, v, o, lse, do, causal, block_size):
+    BH, Tq, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / float(D) ** 0.5
+    bq = _mha_block(block_size, Tq)
+    bk = _mha_block(block_size, Tk)
+    qf = _pad_to(q, 1, bq)
+    kf = _pad_to(k, 1, bk)
+    vf = _pad_to(v, 1, bk)
+    dof = _pad_to(do.astype(q.dtype), 1, bq)
+    # Δ = rowsum(do ∘ o) — one cheap fused elementwise+reduce outside
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    deltaf = _pad_to(jnp.broadcast_to(delta[..., None],
+                                      (BH, Tq, 128)), 1, bq)
+    lsef = _pad_to(lse, 1, bq)  # already (BH, Tq, 128) lane-broadcast
+    Tqp, Tkp = qf.shape[1], kf.shape[1]
+    nq, nk = Tqp // bq, Tkp // bk
+    kw = dict(causal=causal, block_q=bq, block_k=bk, tq_valid=Tq,
+              tk_valid=Tk, scale=scale)
+    cparams = (pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        vmem_limit_bytes=100 * 1024 * 1024)
+        if pltpu is not None and not _interpret() else None)
+
+    dq = pl.pallas_call(
+        functools.partial(_mha_bwd_dq_kernel, nk=nk, **kw),
+        grid=(BH, nq, nk),
+        in_specs=[
+            _vmem_spec((1, bq, D), lambda bh, qi, kj: (bh, qi, 0)),
+            _vmem_spec((1, bk, D), lambda bh, qi, kj: (bh, kj, 0)),
+            _vmem_spec((1, bk, D), lambda bh, qi, kj: (bh, kj, 0)),
+            _vmem_spec((1, bq, D), lambda bh, qi, kj: (bh, qi, 0)),
+            _vmem_spec((1, bq, 128), lambda bh, qi, kj: (bh, qi, 0)),
+            _vmem_spec((1, bq, 128), lambda bh, qi, kj: (bh, qi, 0)),
+        ],
+        out_specs=[_vmem_spec((1, bq, D), lambda bh, qi, kj: (bh, qi, 0))],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tqp, D), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=cparams,
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lsef, deltaf)[0]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_mha_bwd_dkv_kernel, nq=nq, **kw),
+        grid=(BH, nk, nq),
+        in_specs=[
+            _vmem_spec((1, bq, D), lambda bh, kj, qi: (bh, qi, 0)),
+            _vmem_spec((1, bk, D), lambda bh, kj, qi: (bh, kj, 0)),
+            _vmem_spec((1, bk, D), lambda bh, kj, qi: (bh, kj, 0)),
+            _vmem_spec((1, bq, D), lambda bh, kj, qi: (bh, qi, 0)),
+            _vmem_spec((1, bq, 128), lambda bh, kj, qi: (bh, qi, 0)),
+            _vmem_spec((1, bq, 128), lambda bh, kj, qi: (bh, qi, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, bk, D), lambda bh, kj, qi: (bh, kj, 0)),
+            _vmem_spec((1, bk, D), lambda bh, kj, qi: (bh, kj, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((BH, Tkp, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tkp, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=cparams,
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    return dq[:, :Tq], dk[:, :Tk], dv[:, :Tk]
+
+
+# ---------------------------------------------------------------------------
+# Packed-heads flash MHA — attention straight off the fused QKV matmul.
+#
+# The (BH, T, D) layouts above still require a T↔H relayout between the
+# model's (B, T, H·D) activations and the kernel — measured at ~20 ms
+# per transformer step (tools/profile_transformer.py), because narrow
+# d_head transposes run far below HBM speed.  This kernel removes the
+# relayout entirely: q, k, v are LANE-BLOCK VIEWS of the fused QKV
+# projection output (B, T, 3·H·D) — the same array is passed three
+# times with different lane-block index maps — and every head occupies
+# its own 64/128-lane span inside the block.  The kernel loops over
+# heads per (q-block, k-block) tile, keeping each head's online-softmax
+# state broadcast over that head's lane span in VMEM scratch.  The
+# output is written directly in (B, T, H·D) — the layout the following
+# projection matmul wants.  Zero transposes in forward or backward.
+# ---------------------------------------------------------------------------
+
+
+def _mhap_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                     l_ref, *, H, D, causal, block_q, block_k, tq_valid,
+                     tk_valid, scale, nk):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    if causal:
+        run = (kj * block_k) <= (qi * block_q + block_q - 1)
+        last_kj = jnp.minimum(nk - 1, (qi * block_q + block_q - 1)
+                              // block_k)
+    else:
+        run = kj >= 0
+        last_kj = nk - 1
+
+    # Static tile specialization: interior tiles need NO masking at all
+    # (the dominant VPU cost after exp), only diagonal tiles (causal)
+    # and edge tiles (T-padding) take the masked path.
+    need_pad = (tk_valid % block_k) != 0
+    mask_cond = jnp.bool_(False)
+    if causal:
+        mask_cond |= (kj == qi) if block_q == block_k else run
+    if need_pad:
+        mask_cond |= (kj == nk - 1)
+
+    def _body(masked):
+        valid = None
+        if masked:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = k_pos < tk_valid
+            if causal:
+                q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                valid = valid & (k_pos <= q_pos)
+        for h in range(H):
+            sl = slice(h * D, (h + 1) * D)
+            q = q_ref[0, :, sl]
+            k = k_ref[0, :, sl]
+            v = v_ref[0, :, sl]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) \
+                * (scale * 1.4426950408889634)  # exp2 domain
+            if masked:
+                s = jnp.where(valid, s, -jnp.inf)
+            m_prev = m_ref[:, h * D]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+            m_safe = jnp.where(m_new == -jnp.inf, 0.0, m_new)
+            # masked entries hold -inf, so exp2 gives exactly 0 — no
+            # second where needed.  (bf16 exp was tried and measured
+            # slower: Mosaic upcasts transcendentals, so the converts
+            # were pure overhead.)
+            p = jnp.exp2(s - m_safe[:, None])
+            alpha = jnp.where(m_prev == -jnp.inf, 0.0,
+                              jnp.exp2(m_prev - m_safe))
+            l_new = l_ref[:, h * D] * alpha + jnp.sum(p, axis=1)
+            l_ref[:, sl] = jnp.broadcast_to(l_new[:, None], (block_q, D))
+            pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            acc_ref[:, sl] = acc_ref[:, sl] * alpha[:, None] + pv
+            m_ref[:, sl] = jnp.broadcast_to(m_new[:, None], (block_q, D))
+
+    @pl.when(run & mask_cond)
+    def _compute_masked():
+        _body(True)
+
+    @pl.when(run & jnp.logical_not(mask_cond))
+    def _compute_full():
+        _body(False)
+
+    @pl.when(kj == last_kj)
+    def _finalize():
+        l = l_ref[...]
+        o_ref[0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log2(jnp.maximum(l, 1e-30))
+
+
+def _mhap_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
+                        dq_ref, acc_ref, delta_ref, *, H, D, causal,
+                        block_q, block_k, tq_valid, tk_valid, scale, nk):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        # Δ = per-(row, head) rowsum(do ∘ o), computed once per q-block
+        # into scratch instead of materializing a (B, T, H·D) f32
+        # broadcast tensor in HBM
+        prod = do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32)
+        for h in range(H):
+            sl = slice(h * D, (h + 1) * D)
+            dh = jnp.sum(prod[:, sl], axis=1)
+            delta_ref[:, sl] = jnp.broadcast_to(dh[:, None], (block_q, D))
+
+    if causal:
+        run = (kj * block_k) <= (qi * block_q + block_q - 1)
+        last_kj = jnp.minimum(nk - 1, (qi * block_q + block_q - 1)
+                              // block_k)
+    else:
+        run = kj >= 0
+        last_kj = nk - 1
+
+    need_pad = (tk_valid % block_k) != 0 or (tq_valid % block_q) != 0
+    mask_cond = jnp.bool_(False)
+    if causal:
+        mask_cond |= (kj == qi) if block_q == block_k else run
+    if need_pad:
+        mask_cond |= (kj == nk - 1) | (qi == pl.num_programs(1) - 1)
+
+    def _body(masked):
+        valid = None
+        if masked:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = (k_pos < tk_valid) & (q_pos < tq_valid)
+            if causal:
+                valid = valid & (k_pos <= q_pos)
+        for h in range(H):
+            sl = slice(h * D, (h + 1) * D)
+            q = q_ref[0, :, sl]
+            k = k_ref[0, :, sl]
+            v = v_ref[0, :, sl]
+            do = do_ref[0, :, sl]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) \
+                * (scale * 1.4426950408889634)  # exp2-domain lse
+            p = jnp.exp2(s - lse_ref[0, :, h * D][:, None])
+            if masked:
+                p = jnp.where(valid, p, 0.0)
+            dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            ds = p * (dov - delta_ref[:, h * D][:, None])
+            acc_ref[:, sl] += jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+
+    @pl.when(run & mask_cond)
+    def _compute_masked():
+        _body(True)
+
+    @pl.when(run & jnp.logical_not(mask_cond))
+    def _compute_full():
+        _body(False)
+
+    @pl.when(kj == last_kj)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _mhap_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref,
+                         dk_ref, dv_ref, dka_ref, dva_ref, *, H, D, causal,
+                         block_q, block_k, tq_valid, tk_valid, scale, nq):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dka_ref[...] = jnp.zeros_like(dka_ref)
+        dva_ref[...] = jnp.zeros_like(dva_ref)
+
+
+    if causal:
+        run = (kj * block_k) <= (qi * block_q + block_q - 1)
+    else:
+        run = qi >= 0
+
+    need_pad = (tk_valid % block_k) != 0 or (tq_valid % block_q) != 0
+    mask_cond = jnp.bool_(False)
+    if causal:
+        mask_cond |= (kj == qi) if block_q == block_k else run
+    if need_pad:
+        mask_cond |= (kj == pl.num_programs(1) - 1) | (qi == nq - 1)
+
+    def _body(masked):
+        valid = None
+        if masked:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = (k_pos < tk_valid) & (q_pos < tq_valid)
+            if causal:
+                valid = valid & (k_pos <= q_pos)
+        for h in range(H):
+            sl = slice(h * D, (h + 1) * D)
+            q = q_ref[0, :, sl]
+            k = k_ref[0, :, sl]
+            v = v_ref[0, :, sl]
+            do = do_ref[0, :, sl]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) \
+                * (scale * 1.4426950408889634)  # exp2-domain lse
+            p = jnp.exp2(s - lse_ref[0, :, h * D][:, None])
+            if masked:
+                p = jnp.where(valid, p, 0.0)
+            pT = p.astype(do.dtype)
+            dva_ref[:, sl] += jax.lax.dot_general(
+                pT, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dov = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            # Δ rows for this q-block: cheap in-register rowsum (qi is
+            # the inner axis, so no per-q-block scratch caching here)
+            dh = jnp.sum(do.astype(jnp.float32)
+                         * o_ref[0, :, sl].astype(jnp.float32), axis=1)
+            ds = p * (dov - dh[:, None])
+            dka_ref[:, sl] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+
+    @pl.when(run & mask_cond)
+    def _compute_masked():
+        _body(True)
+
+    @pl.when(run & jnp.logical_not(mask_cond))
+    def _compute_full():
+        _body(False)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dka_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dva_ref[...].astype(dv_ref.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _flash_mha_packed_fn(H, D, causal, block_size):
+    @jax.custom_vjp
+    def f(qkv):
+        o, _ = _mhap_fwd(qkv, H, D, causal, block_size)
+        return o
+
+    def fwd(qkv):
+        o, lse = _mhap_fwd(qkv, H, D, causal, block_size)
+        return o, (qkv, o, lse)
+
+    def bwd(res, do):
+        qkv, o, lse = res
+        return (_mhap_bwd(qkv, o, lse, do, H, D, causal, block_size),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+def flash_mha_packed(qkv, num_heads, causal=False, block_size=512):
+    """Fused-QKV flash attention: qkv (B, T, 3·H·D) — the raw output of
+    the fused projection matmul, laid out [q | k | v] with each head on
+    its own D-lane span — → (B, T, H·D).  Differentiable; the qkv
+    cotangent comes back packed the same way."""
+    B, T, HD3 = qkv.shape
+    if HD3 % (3 * num_heads):
+        raise ValueError(f"qkv last dim {HD3} not 3*H*D for H={num_heads}")
+    D = HD3 // (3 * num_heads)
+    return _flash_mha_packed_fn(int(num_heads), int(D), bool(causal),
+                                int(block_size))(qkv)
+
+
+def _mhap_fwd(qkv, H, D, causal, block_size):
+    B, T, _ = qkv.shape
+    HD = H * D
+    scale = 1.0 / float(D) ** 0.5
+    bq = bk = _mha_block(block_size, T)
+    qkvf = _pad_to(qkv, 1, bq)
+    Tp = qkvf.shape[1]
+    nq = nk = Tp // bq
+    kern = functools.partial(
+        _mhap_fwd_kernel, H=H, D=D, causal=causal, block_q=bq, block_k=bk,
+        tq_valid=T, tk_valid=T, scale=scale, nk=nk)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(B, nq, nk),
+        in_specs=[
+            _vmem_spec((1, bq, HD), lambda b, qi, kj: (b, qi, 0)),
+            _vmem_spec((1, bk, HD), lambda b, qi, kj: (b, kj, 1)),
+            _vmem_spec((1, bk, HD), lambda b, qi, kj: (b, kj, 2)),
+        ],
+        out_specs=[
+            _vmem_spec((1, bq, HD), lambda b, qi, kj: (b, qi, 0)),
+            _vmem_spec((1, bq, HD), lambda b, qi, kj: (b, qi, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B, Tp, HD), qkv.dtype),
+                   jax.ShapeDtypeStruct((B, Tp, HD), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, HD), jnp.float32),
+                        pltpu.VMEM((bq, HD), jnp.float32),
+                        pltpu.VMEM((bq, HD), jnp.float32)],
+        compiler_params=(pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024)
+            if pltpu is not None and not _interpret() else None),
+        interpret=_interpret(),
+    )(qkvf, qkvf, qkvf)
+    return o[:, :T], lse[:, :T]
+
+
+def _mhap_bwd(qkv, o, lse, do, H, D, causal, block_size):
+    B, T, _ = qkv.shape
+    HD = H * D
+    scale = 1.0 / float(D) ** 0.5
+    bq = bk = _mha_block(block_size, T)
+    qkvf = _pad_to(qkv, 1, bq)
+    dof = _pad_to(do.astype(qkv.dtype), 1, bq)
+    of = _pad_to(o, 1, bq)  # Δ = rowsum(do∘o) computed inside the kernels
+    lsef = _pad_to(lse, 1, bq)
+    Tp = qkvf.shape[1]
+    nq = nk = Tp // bq
+    kw = dict(H=H, D=D, causal=causal, block_q=bq, block_k=bk,
+              tq_valid=T, tk_valid=T, scale=scale)
+    cparams = (pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        vmem_limit_bytes=100 * 1024 * 1024)
+        if pltpu is not None and not _interpret() else None)
+
+    dq = pl.pallas_call(
+        functools.partial(_mhap_bwd_dq_kernel, nk=nk, **kw),
+        grid=(B, nq, nk),
+        in_specs=[
+            _vmem_spec((1, bq, HD), lambda b, qi, kj: (b, qi, 0)),
+            _vmem_spec((1, bk, HD), lambda b, qi, kj: (b, kj, 1)),
+            _vmem_spec((1, bk, HD), lambda b, qi, kj: (b, kj, 2)),
+            _vmem_spec((1, bq, HD), lambda b, qi, kj: (b, qi, 0)),
+            _vmem_spec((1, bq, HD), lambda b, qi, kj: (b, qi, 0)),
+            _vmem_spec((1, bq, HD), lambda b, qi, kj: (b, qi, 0)),
+        ],
+        out_specs=[_vmem_spec((1, bq, HD), lambda b, qi, kj: (b, qi, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B, Tp, HD), qkv.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, HD), jnp.float32),
+                        pltpu.VMEM((bq, HD), jnp.float32)],
+        compiler_params=cparams,
+        interpret=_interpret(),
+    )(qkvf, qkvf, qkvf, dof, lsef, of)[0]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_mhap_bwd_dkv_kernel, nq=nq, **kw),
+        grid=(B, nk, nq),
+        in_specs=[
+            _vmem_spec((1, bq, HD), lambda b, kj, qi: (b, qi, 0)),
+            _vmem_spec((1, bk, HD), lambda b, kj, qi: (b, kj, 1)),
+            _vmem_spec((1, bk, HD), lambda b, kj, qi: (b, kj, 2)),
+            _vmem_spec((1, bq, HD), lambda b, kj, qi: (b, qi, 0)),
+            _vmem_spec((1, bq, HD), lambda b, kj, qi: (b, qi, 0)),
+            _vmem_spec((1, bq, HD), lambda b, kj, qi: (b, qi, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, bk, HD), lambda b, kj, qi: (b, kj, 0)),
+            _vmem_spec((1, bk, HD), lambda b, kj, qi: (b, kj, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B, Tp, HD), qkv.dtype),
+                   jax.ShapeDtypeStruct((B, Tp, HD), qkv.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, HD), jnp.float32),
+                        pltpu.VMEM((bk, HD), jnp.float32)],
+        compiler_params=cparams,
+        interpret=_interpret(),
+    )(qkvf, qkvf, qkvf, dof, lsef, of)
+
+    return jnp.concatenate([dq[:, :T], dk[:, :T], dv[:, :T]], axis=-1)

@@ -1,0 +1,118 @@
+import numpy as np
+import jax, jax.numpy as jnp
+import pytest
+import mxnet_tpu as mx
+from mxnet_tpu.ops import attention as att, pallas_kernels as pk
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", [(2, 384, 3, 64), (1, 256, 2, 128)])
+def test_flash_mha_parity(monkeypatch, causal, shape):
+    monkeypatch.setenv("MXNET_PALLAS", "1")
+    assert pk.enabled()
+    B, T, H, D = shape
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B,T,H,D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B,T,H,D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B,T,H,D).astype(np.float32))
+    def f_kern(q,k,v): return att.blockwise_attention(q,k,v,causal=causal,block_size=256)
+    def f_lax(q,k,v):
+        o,m,l = att._blockwise_attention_partial_lax(q,k,v,causal,256,0)
+        return att.normalize_attention_state(o,m,l,q.dtype)
+    ok, ol = f_kern(q,k,v), f_lax(q,k,v)
+    assert float(jnp.abs(ok-ol).max()) < 1e-5
+    gk = jax.grad(lambda q,k,v: jnp.sum(jnp.sin(f_kern(q,k,v))), argnums=(0,1,2))(q,k,v)
+    gl = jax.grad(lambda q,k,v: jnp.sum(jnp.sin(f_lax(q,k,v))), argnums=(0,1,2))(q,k,v)
+    for a, b in zip(gk, gl):
+        assert float(jnp.abs(a-b).max()) < 1e-5
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_packed_qkv_parity(monkeypatch, causal):
+    monkeypatch.setenv("MXNET_PALLAS", "1")
+    from mxnet_tpu.ops import pallas_kernels as pk2
+    B, T, H, D = 2, 384, 3, 64
+    rng = np.random.RandomState(1)
+    qkv = jnp.asarray(rng.randn(B, T, 3*H*D).astype(np.float32))
+    def f_kern(qkv):
+        return pk2.flash_mha_packed(qkv, H, causal=causal, block_size=256)
+    def f_lax(qkv):
+        q, k, v = (jnp.reshape(x, (B, T, H, D)) for x in jnp.split(qkv, 3, -1))
+        o, m, l = att._blockwise_attention_partial_lax(q, k, v, causal, 256, 0)
+        return jnp.reshape(att.normalize_attention_state(o, m, l, qkv.dtype), (B, T, H*D))
+    ok, ol = f_kern(qkv), f_lax(qkv)
+    assert float(jnp.abs(ok - ol).max()) < 1e-5
+    gk = jax.grad(lambda x: jnp.sum(jnp.sin(f_kern(x))))(qkv)
+    gl = jax.grad(lambda x: jnp.sum(jnp.sin(f_lax(x))))(qkv)
+    assert float(jnp.abs(gk - gl).max()) < 1e-5
+
+
+def test_softmax_ce_loss_head():
+    """SoftmaxCELoss: forward loss parity with SoftmaxOutput-derived CE
+    and the (p - onehot) backward, without materializing probs."""
+    rng = np.random.RandomState(3)
+    B, T, V = 2, 8, 32
+    logits = rng.randn(B, T, V).astype(np.float32)
+    label = rng.randint(0, V, size=(B, T)).astype(np.float32)
+    sym = mx.sym.SoftmaxCELoss(mx.sym.Variable("data"),
+                               mx.sym.Variable("label"))
+    ld = mx.nd.array(logits)
+    gd = mx.nd.zeros(logits.shape)
+    ex = sym.bind(mx.cpu(), {"data": ld, "label": mx.nd.array(label)},
+                  args_grad={"data": gd})
+    out = ex.forward(is_train=True)[0].asnumpy()
+    # reference CE
+    x = logits - logits.max(-1, keepdims=True)
+    lse = np.log(np.exp(x).sum(-1)) + logits.max(-1)
+    ll = np.take_along_axis(logits, label[..., None].astype(int), -1)[..., 0]
+    np.testing.assert_allclose(out, lse - ll, rtol=1e-5, atol=1e-5)
+    ex.backward(out_grads=[mx.nd.ones(out.shape)])
+    p = np.exp(logits - lse[..., None])
+    onehot = np.eye(V)[label.astype(int)]
+    np.testing.assert_allclose(gd.asnumpy(), p - onehot, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_transformer_ce_head_trains():
+    import mxnet_tpu.models as models
+    sym = models.transformer_lm(vocab_size=64, seq_len=16, num_layers=1,
+                                num_heads=2, d_model=32, head="ce")
+    rng = np.random.RandomState(0)
+    X = rng.randint(1, 64, size=(4, 16)).astype(np.float32)
+    Y = rng.randint(1, 64, size=(4, 16)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=4, label_name="softmax_label")
+
+    class MeanLoss(mx.metric.EvalMetric):
+        def __init__(self):
+            super().__init__("mean_loss")
+
+        def update(self, labels, preds):
+            self.sum_metric += float(preds[0].asnumpy().mean())
+            self.num_inst += 1
+
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mx.random.seed(0)
+    losses = []
+    mod.fit(it, num_epoch=30, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-2},
+            initializer=mx.initializer.Xavier(), eval_metric=MeanLoss(),
+            batch_end_callback=lambda p: losses.append(
+                p.eval_metric.get()[1]))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_softmax_ce_loss_ignore_label():
+    rng = np.random.RandomState(4)
+    logits = rng.randn(2, 6, 16).astype(np.float32)
+    label = rng.randint(1, 16, size=(2, 6)).astype(np.float32)
+    label[0, 2] = 0  # padding
+    sym = mx.sym.SoftmaxCELoss(mx.sym.Variable("data"),
+                               mx.sym.Variable("label"),
+                               use_ignore=True, ignore_label=0)
+    ld, gd = mx.nd.array(logits), mx.nd.zeros(logits.shape)
+    ex = sym.bind(mx.cpu(), {"data": ld, "label": mx.nd.array(label)},
+                  args_grad={"data": gd})
+    out = ex.forward(is_train=True)[0].asnumpy()
+    assert out[0, 2] == 0.0 and out[0, 3] > 0.0
+    ex.backward(out_grads=[mx.nd.ones(out.shape)])
+    g = gd.asnumpy()
+    np.testing.assert_allclose(g[0, 2], 0.0, atol=1e-8)
+    assert np.abs(g[0, 3]).max() > 0
