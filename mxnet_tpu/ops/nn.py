@@ -428,6 +428,35 @@ def _pool_infer(attrs, in_shapes):
 get_op("Pooling").infer_shape = _pool_infer
 
 
+def _fused_mean_var(xf, in_dtype, axes, shift_slice, keepdims):
+    """Single-pass normalization statistics: E[x] and E[x^2] reduce over
+    the same input so XLA fuses them into ONE HBM read of x (two-pass
+    mean+var reads twice; measured 747 vs 374 GB/s effective on a
+    [256,256,56,56] bf16 tensor — BN-heavy models are HBM-bound, so
+    this is ~20% of BN fwd+bwd device time).
+
+    The dtype gate: bfloat16 inputs use the UNSHIFTED form — their
+    8-bit mantissa cannot represent std below mean/256, so the f32
+    accumulator keeps >=100x cancellation headroom, and the shift
+    measured a 9 ms/step ResNet-50 regression by breaking XLA's fused
+    reduce pattern (tools/roofline_resnet.py, PERF.md).  Everything
+    else (f32, and f16 whose 10-bit mantissa CAN express the hazard)
+    subtracts a stop-gradient sampled shift s — always inside the
+    data's range — so E[(x-s)^2] - E[x-s]^2 cannot catastrophically
+    cancel when |mean| >> std (round-4 advisor finding)."""
+    if in_dtype == jnp.bfloat16:
+        mean = jnp.mean(xf, axis=axes, keepdims=keepdims)
+        mean_sq = jnp.mean(lax.square(xf), axis=axes, keepdims=keepdims)
+        return mean, jnp.maximum(mean_sq - lax.square(mean), 0.0)
+    shift = jax.lax.stop_gradient(xf[shift_slice])
+    xs = xf - shift
+    mean_s = jnp.mean(xs, axis=axes, keepdims=keepdims)
+    mean_sq = jnp.mean(lax.square(xs), axis=axes, keepdims=keepdims)
+    var = jnp.maximum(mean_sq - lax.square(mean_s), 0.0)
+    mean = mean_s + (shift if keepdims else shift.reshape(-1))
+    return mean, var
+
+
 # ---------------------------------------------------------------------------
 # BatchNorm (aux: moving_mean, moving_var)
 # ---------------------------------------------------------------------------
@@ -450,24 +479,14 @@ def _batch_norm(op_ctx, attrs, inputs, aux):
     if fix_gamma:
         gamma = jax.lax.stop_gradient(jnp.ones_like(gamma))
     if op_ctx.is_train and not use_global:
-        # Single-pass statistics: E[x-s] and E[(x-s)^2] reduce over the
-        # same input so XLA fuses them into one HBM read of x, where
-        # mean+var (two-pass) reads x twice.  Measured on v5e for a
-        # [256,256,56,56] bf16 tensor: 0.55 ms vs 1.10 ms (747 GB/s vs
-        # 374 GB/s effective) — BN-heavy models are HBM-bound, so this
-        # is a ~20% cut of BN fwd+bwd device time.  The per-channel
-        # shift s (one sampled element per channel, so always inside the
-        # data's range) keeps E[(x-s)^2] - E[x-s]^2 from catastrophically
-        # cancelling in f32 when |mean| >> std; the clamp then only
-        # absorbs last-ulp noise instead of masking a wrong var.
+        # Single-pass statistics (see _fused_mean_var): one fused HBM
+        # read of x, with the cancellation-guarding shift dtype-gated to
+        # keep XLA's reduce-fusion pattern for bf16 models.
         xf = x.astype(jnp.float32)
-        shift = jax.lax.stop_gradient(
-            xf[(slice(0, 1), slice(None)) + (slice(0, 1),) * (x.ndim - 2)])
-        xs = xf - shift
-        mean_s = jnp.mean(xs, axis=axes)
-        mean_sq = jnp.mean(lax.square(xs), axis=axes)
-        var = jnp.maximum(mean_sq - lax.square(mean_s), 0.0)
-        mean = mean_s + shift.reshape(-1)
+        shift_slice = (slice(0, 1), slice(None)) \
+            + (slice(0, 1),) * (x.ndim - 2)
+        mean, var = _fused_mean_var(xf, x.dtype, axes, shift_slice,
+                                    keepdims=False)
         mean = mean.astype(moving_mean.dtype)
         var = var.astype(moving_var.dtype)
         new_mean = moving_mean * momentum + mean * (1 - momentum)
@@ -522,16 +541,10 @@ def _layer_norm(op_ctx, attrs, inputs, aux):
     output_mean_var = attr_bool(attrs.get("output_mean_var"), False)
     ax = axis % x.ndim
     xf = x.astype(jnp.float32)
-    # per-row shift (first element along the axis) guards the single-pass
-    # E[(x-s)^2] - E[x-s]^2 variance against catastrophic cancellation
-    # when |mean| >> std; still one fused HBM read of x
-    shift = jax.lax.stop_gradient(
-        lax.slice_in_dim(xf, 0, 1, axis=ax))
-    xs = xf - shift
-    mean_s = jnp.mean(xs, axis=ax, keepdims=True)
-    mean_sq = jnp.mean(lax.square(xs), axis=ax, keepdims=True)
-    var = jnp.maximum(mean_sq - lax.square(mean_s), 0.0)
-    mean = mean_s + shift
+    shift_slice = tuple(slice(0, 1) if i == ax else slice(None)
+                        for i in range(x.ndim))
+    mean, var = _fused_mean_var(xf, x.dtype, ax, shift_slice,
+                                keepdims=True)
     inv = lax.rsqrt(var + eps)
     bshape = [1] * x.ndim
     bshape[ax] = x.shape[ax]

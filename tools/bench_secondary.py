@@ -260,6 +260,7 @@ def bench_inference(batch=32, iters=100, network="resnet-50",
         + ", ".join(f"{m:.2f}" for m in window_ms)
         + (f"; device {dev_ms:.3f} ms" if dev_ms else ""))
     base = P100_SWEEP.get(network)
+    dev_rate = batch * 1000 / dev_ms if dev_ms else None
     return {
         "metric": f"{network.replace('-', '')}_inference_score"
                   if network != "resnet-50" else "resnet50_inference_score",
@@ -269,6 +270,10 @@ def bench_inference(batch=32, iters=100, network="resnet-50",
         "precision": precision,
         "vs_baseline": (round(batch * 1000 / best / base, 3)
                         if base else None),
+        # wall time through the sandbox tunnel is dispatch-dominated for
+        # small nets; the device ratio is the honest hardware comparison
+        "vs_baseline_device": (round(dev_rate / base, 3)
+                               if base and dev_rate else None),
         "baseline_precision": "fp32",
         "batch_ms": round(best, 3),
         "batch_ms_median": round(float(np.median(window_ms)), 3),
@@ -488,8 +493,12 @@ def bench_ssd(batch=64, size=64, iters=60):
 
     import mxnet_tpu as mx
 
+    # examples/ resolve their shared helpers relative to their own dir
+    ex_dir = os.path.join(_REPO, "examples")
+    if ex_dir not in sys.path:
+        sys.path.insert(0, ex_dir)
     spec = importlib.util.spec_from_file_location(
-        "ssd_example", os.path.join(_REPO, "examples", "ssd.py"))
+        "ssd_example", os.path.join(ex_dir, "ssd.py"))
     ssd = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(ssd)
 
