@@ -774,10 +774,22 @@ def flash_mha(q, k, v, causal=False, block_size=512):
     return _flash_mha_fn(bool(causal), int(block_size))(q, k, v)
 
 
+def _sds_t(shape, dtype, vma):
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _mha_fwd(q, k, v, causal, block_size):
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / float(D) ** 0.5
+    # under shard_map (Ulysses sequence parallelism) the outputs vary
+    # over the same mesh axes as the inputs; pallas_call must declare it
+    try:
+        vma = jax.typeof(q).vma | jax.typeof(k).vma | jax.typeof(v).vma
+    except Exception:
+        vma = frozenset()
     bq = _mha_block(block_size, Tq)
     bk = _mha_block(block_size, Tk)
     qf = _pad_to(q, 1, bq)
@@ -803,8 +815,8 @@ def _mha_fwd(q, k, v, causal, block_size):
             _vmem_spec((1, bq, D), lambda bh, qi, kj: (bh, qi, 0)),
             _vmem_spec((1, bq, 128), lambda bh, qi, kj: (bh, qi, 0)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((BH, Tqp, D), q.dtype),
-                   jax.ShapeDtypeStruct((BH, Tqp, 128), jnp.float32)],
+        out_shape=[_sds_t((BH, Tqp, D), q.dtype, vma),
+                   _sds_t((BH, Tqp, 128), jnp.float32, vma)],
         scratch_shapes=scratch,
         compiler_params=(pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -819,6 +831,11 @@ def _mha_bwd(q, k, v, o, lse, do, causal, block_size):
     BH, Tq, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / float(D) ** 0.5
+    try:
+        vma = (jax.typeof(q).vma | jax.typeof(k).vma | jax.typeof(v).vma
+               | jax.typeof(do).vma)
+    except Exception:
+        vma = frozenset()
     bq = _mha_block(block_size, Tq)
     bk = _mha_block(block_size, Tk)
     qf = _pad_to(q, 1, bq)
@@ -851,7 +868,7 @@ def _mha_bwd(q, k, v, o, lse, do, causal, block_size):
             _vmem_spec((1, bq, 128), lambda bh, qi, kj: (bh, qi, 0)),
         ],
         out_specs=[_vmem_spec((1, bq, D), lambda bh, qi, kj: (bh, qi, 0))],
-        out_shape=[jax.ShapeDtypeStruct((BH, Tqp, D), q.dtype)],
+        out_shape=[_sds_t((BH, Tqp, D), q.dtype, vma)],
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=cparams,
         interpret=_interpret(),
@@ -872,8 +889,8 @@ def _mha_bwd(q, k, v, o, lse, do, causal, block_size):
             _vmem_spec((1, bk, D), lambda bh, kj, qi: (bh, kj, 0)),
             _vmem_spec((1, bk, D), lambda bh, kj, qi: (bh, kj, 0)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((BH, Tkp, D), k.dtype),
-                   jax.ShapeDtypeStruct((BH, Tkp, D), v.dtype)],
+        out_shape=[_sds_t((BH, Tkp, D), k.dtype, vma),
+                   _sds_t((BH, Tkp, D), v.dtype, vma)],
         scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
         compiler_params=cparams,

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .base import MXNetError
 from .ops import pallas_kernels as _pk
 from .ops.attention import (attention_state_init, attention_state_merge,
+                            blockwise_attention,
                             blockwise_attention_partial,
                             normalize_attention_state)
 
@@ -129,9 +130,11 @@ def _ulysses_local(q, k, v, axis_name, causal, block_size):
                               tiled=True)
 
     qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    o, m, l = blockwise_attention_partial(qf, kf, vf, causal=causal,
-                                          block_size=block_size)
-    out = normalize_attention_state(o, m, l, q.dtype)
+    # full (non-ring) attention after the a2a: the normalized flash
+    # kernel (in-kernel normalization + Pallas backward) — faster than
+    # partial+normalize with the lax-remat backward
+    out = blockwise_attention(qf, kf, vf, causal=causal,
+                              block_size=block_size)
     return heads_to_seq(out)
 
 

@@ -82,6 +82,57 @@ def train(X, y, batch_size, kvstore, seed=7):
     return {k: v.asnumpy() for k, v in args.items()}
 
 
+def train_tp(rank):
+    """dp=4 × tp=2 over the SAME process-spanning mesh: each host owns
+    two whole dp rows (tp pairs stay within a host — the layout
+    MeshPlan.batch_scale enforces); the fc1 weight is tensor-sharded
+    over 'tp'."""
+    import jax
+
+    from mxnet_tpu import parallel
+
+    mx.random.seed(11 + rank)  # broadcast must still unify
+    rng = np.random.RandomState(9)
+    X = rng.randn(32, 16).astype(np.float32)
+    y = rng.randint(0, 4, size=32).astype(np.float32)
+    Xs, ys = X[rank::2], y[rank::2]
+    it = mx.io.NDArrayIter(Xs, ys, batch_size=8, shuffle=False,
+                           label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1",
+                                attr=parallel.shard_attr("tp", 0))
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(mx.initializer.Xavier())
+    mod.set_mesh_plan(parallel.MeshPlan(jax.devices(), tp=2))
+    losses = []
+
+    class CE(mx.metric.EvalMetric):
+        def __init__(self):
+            super().__init__("ce")
+
+        def update(self, labels, preds):
+            p = preds[0].asnumpy()
+            lab = labels[0].asnumpy().astype(int)
+            self.sum_metric += float(-np.log(np.maximum(
+                p[np.arange(len(lab)), lab], 1e-9)).mean())
+            self.num_inst += 1
+
+    mod.fit(it, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(), eval_metric=CE(),
+            batch_end_callback=lambda p: losses.append(
+                p.eval_metric.get()[1]))
+    args, _ = mod.get_params()
+    digest = sum(float(v.asnumpy().sum()) for v in args.values())
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    return digest
+
+
 def main():
     out_path = sys.argv[1]
     kv = mx.kv.create("tpu")  # wires jax.distributed from launcher env
@@ -99,6 +150,12 @@ def main():
     np.savez(out_path + f".rank{rank}", **params)
     kv.barrier()
     print(f"worker {rank}/{nw}: module fit tpu mesh OK", flush=True)
+
+    # phase 2: dp=4 x tp=2 (tensor parallelism within each host) over
+    # the same process-spanning mesh
+    digest = train_tp(rank)
+    print(f"worker {rank}/{nw}: tp mesh OK digest={digest:.6f}",
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -147,6 +147,12 @@ def test_launch_module_fit_tpu_mesh(tmp_path):
     assert r.returncode == 0, o
     assert "worker 0/2: module fit tpu mesh OK" in o
     assert "worker 1/2: module fit tpu mesh OK" in o
+    # dp=4 x tp=2 phase: both ranks train through the tensor-sharded
+    # weight and read back identical replicated weights
+    import re as _re
+    tp_digests = _re.findall(r"tp mesh OK digest=(-?[\d.]+)", o)
+    assert len(tp_digests) == 2, o
+    assert tp_digests[0] == tp_digests[1], tp_digests
 
     # single-process reference: same union data, global batch, dp=8 mesh
     sys.path.insert(0, os.path.join(REPO, "tests"))

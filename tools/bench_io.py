@@ -224,7 +224,7 @@ def train_real(n_images=1024, batch=128, epochs=3):
         mod.get_outputs()[0].wait_to_read()
         log(f"warm epoch ({n} imgs) + compile {time.time()-t0:.1f}s")
 
-        rates, dev_busy_ms, wall_ms = [], None, None
+        rates, dev_busy_ms = [], None
         for e in range(epochs):
             it.reset()
             trace_dir = tempfile.mkdtemp(prefix="io_trace_") \
@@ -245,7 +245,6 @@ def train_real(n_images=1024, batch=128, epochs=3):
             dt = time.time() - t0
             rates.append(m / dt)
             if trace_dir:
-                wall_ms = dt * 1000
                 try:
                     ms_per, n_exec = dominant_module_ms(trace_dir)
                     dev_busy_ms = ms_per * n_exec
@@ -257,9 +256,10 @@ def train_real(n_images=1024, batch=128, epochs=3):
         loss = float(-np.log(np.maximum(
             probs[np.arange(len(lab)), lab], 1e-12)).mean())
         best = max(rates)
-        # idle from per-image device time x the best UNTRACED rate: the
+        # idle from per-image device time x the best measured rate (the
         # profiler itself loads this 1-core host, so the traced epoch's
-        # wall clock would overstate idleness
+        # wall clock would overstate idleness; its rate can still win
+        # the max() if it happens to be fastest)
         idle_frac = (1.0 - (dev_busy_ms / 1e3 / n_images) * best
                      if dev_busy_ms else None)
         log("end-to-end real-data training: "

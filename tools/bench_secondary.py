@@ -616,6 +616,12 @@ def main():
     print(json.dumps(results[-1]), flush=True)
     results.append(bench_ssd())
     print(json.dumps(results[-1]), flush=True)
+    # long-context row: T=4096 causal (attention-dominant regime for
+    # the packed flash kernel); same tokens/step as the T=1024 row
+    long_row = bench_transformer(T=4096, batch=2, iters=30)
+    long_row["metric"] = "transformer_lm_long_context_train_throughput"
+    results.append(long_row)
+    print(json.dumps(results[-1]), flush=True)
     # the reference's benchmark_score.py 5-net sweep (perf.md:69-100);
     # inception-v3 runs 299x299 like the reference's benchmark_score.py
     # (its P100 number was measured at that shape)

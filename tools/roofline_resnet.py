@@ -28,7 +28,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np
 
-from profile_step import build_module, find_xplane, parse_xplane
+from profile_step import find_xplane, parse_xplane, run_trace
 
 ACHIEVABLE_GBS = 745.0  # measured STREAM-like ceiling on this v5e (PERF.md)
 PEAK_TFLOPS = 197.0
@@ -55,19 +55,8 @@ def tensor_bytes(expr):
 
 
 def main():
-    steps = 10
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
-    mod, b = build_module(batch)
-    for _ in range(3):
-        mod.forward_backward(b)
-        mod.update()
-    mod.get_outputs()[0].wait_to_read()
     tdir = tempfile.mkdtemp(prefix="roofline_")
-    with jax.profiler.trace(tdir):
-        for _ in range(steps):
-            mod.forward_backward(b)
-            mod.update()
-        mod.get_outputs()[0].wait_to_read()
+    steps, _batch = run_trace(tdir)  # profile_step's exact recipe
     (mod_ms, mod_n), busy_ms, rows = parse_xplane(find_xplane(tdir))
     step_ms = busy_ms / steps
     print(f"\ndevice busy {step_ms:.3f} ms/step (module span {mod_ms:.3f})")
