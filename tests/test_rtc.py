@@ -111,3 +111,26 @@ def test_user_kernel_example_end_to_end():
     m = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(m)
     m.main()
+
+
+def test_pallas_op_custom_grid_and_specs():
+    """grid/in_specs/out_specs pass through to pl.pallas_call: a tiled
+    row-scaling kernel over a (256, 256) input."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    name = _unique("tiledscale")
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 3.0
+
+    mx.rtc.pallas_op(
+        name, kern, arg_names=("data",),
+        out_like=lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        grid=(2, 2),
+        in_specs=[pl.BlockSpec((128, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((128, 128), lambda i, j: (i, j)))
+
+    x = np.arange(256 * 256, dtype=np.float32).reshape(256, 256) % 97
+    out = getattr(mx.nd, name)(mx.nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, x * 3.0, rtol=1e-6)

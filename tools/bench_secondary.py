@@ -626,6 +626,7 @@ def main():
     # inception-v3 runs 299x299 like the reference's benchmark_score.py
     # (its P100 number was measured at that shape)
     for net, shp in (("alexnet", (3, 224, 224)), ("vgg", (3, 224, 224)),
+                     ("inception-bn", (3, 224, 224)),
                      ("inception-v3", (3, 299, 299)),
                      ("resnet-152", (3, 224, 224))):
         results.append(bench_inference(network=net, iters=50,
