@@ -745,6 +745,15 @@ def _mha_block(block_size, t):
     return min(b, max(128, ((t + 127) // 128) * 128))
 
 
+def _mha_blocks(block_size, tq, tk):
+    """(block_q, block_k) for the normalized flash_mha kernels.
+    Symmetric; auto picks 1024 at T>=2048 (the r5 sweep, PERF.md).
+    An asymmetric bq=2048/bk=1024 probe once measured 1.96 ms fwd at
+    T=4096 but was 3.37 ms when reproduced through this API in A/B
+    runs — unreproducible wins don't ship."""
+    return (_mha_block(block_size, tq), _mha_block(block_size, tk))
+
+
 @functools.lru_cache(maxsize=None)
 def _flash_mha_fn(causal, block_size):
     """custom_vjp per (causal, block_size): normalized Pallas forward +
@@ -790,8 +799,7 @@ def _mha_fwd(q, k, v, causal, block_size):
         vma = jax.typeof(q).vma | jax.typeof(k).vma | jax.typeof(v).vma
     except Exception:
         vma = frozenset()
-    bq = _mha_block(block_size, Tq)
-    bk = _mha_block(block_size, Tk)
+    bq, bk = _mha_blocks(block_size, Tq, Tk)
     qf = _pad_to(q, 1, bq)
     kf = _pad_to(k, 1, bk)
     vf = _pad_to(v, 1, bk)
@@ -836,8 +844,7 @@ def _mha_bwd(q, k, v, o, lse, do, causal, block_size):
                | jax.typeof(do).vma)
     except Exception:
         vma = frozenset()
-    bq = _mha_block(block_size, Tq)
-    bk = _mha_block(block_size, Tk)
+    bq, bk = _mha_blocks(block_size, Tq, Tk)
     qf = _pad_to(q, 1, bq)
     kf = _pad_to(k, 1, bk)
     vf = _pad_to(v, 1, bk)
