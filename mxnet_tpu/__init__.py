@@ -48,6 +48,8 @@ from . import operator
 from . import rtc
 from . import predictor
 from .predictor import Predictor
+from . import serving
+from .serving import InferenceEngine
 from . import sequence
 from . import monitor
 from .monitor import Monitor

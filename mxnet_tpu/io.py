@@ -29,8 +29,26 @@ from .ndarray import NDArray, array
 
 __all__ = [
     "DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-    "PrefetchingIter", "MNISTIter", "CSVIter",
+    "PrefetchingIter", "MNISTIter", "CSVIter", "stage_array",
 ]
+
+
+def stage_array(arr, device):
+    """Asynchronously stage one host array onto ``device`` → jax.Array.
+
+    The H2D building block shared by :class:`PrefetchingIter` (batch
+    k+1 transfers while the device computes batch k) and
+    ``serving.InferenceEngine`` (the next micro-batch stages while the
+    current one runs).  ``jax.device_put`` returns immediately; the
+    transfer completes in the background and any compute consuming the
+    result is sequenced after it by XLA."""
+    import jax
+
+    if isinstance(arr, NDArray):
+        arr = arr._data
+    elif not isinstance(arr, np.ndarray) and not hasattr(arr, "devices"):
+        arr = np.asarray(arr)
+    return jax.device_put(arr, device)
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -289,14 +307,10 @@ class PrefetchingIter(DataIter):
     def _stage(self, batch: DataBatch) -> DataBatch:
         if self._ctx is None:
             return batch
-        import jax
-
         dev = self._ctx.jax_device()
 
         def put(arr):
-            if isinstance(arr, NDArray):
-                return NDArray(jax.device_put(arr._data, dev), self._ctx)
-            return NDArray(jax.device_put(np.asarray(arr), dev), self._ctx)
+            return NDArray(stage_array(arr, dev), self._ctx)
 
         return DataBatch([put(d) for d in batch.data],
                          [put(l) for l in (batch.label or [])],

@@ -250,6 +250,7 @@ class DistKVStore(TPUKVStore):
         self._ps = None
         self._sync_round: Dict[Any, int] = {}
         self._key_meta: Dict[Any, tuple] = {}  # key → (shape, dtype)
+        self._needs_init_barrier = False
         super().__init__(kv_type)  # TPUKVStore wires the dist runtime
         self._start_heartbeat()
         if self._async or self._server_sync:
@@ -317,15 +318,56 @@ class DistKVStore(TPUKVStore):
         for row in all_msgs:
             h = bytes(row[1:][row[1:] > 0].astype(_np.uint8)).decode()
             addrs.append((h or "127.0.0.1", int(row[0])))
-        self._ps = ShardedPSClient(addrs, secret=secret)
+        self._ps = ShardedPSClient(addrs, secret=secret, worker=self.rank)
 
     def init(self, key, value):
         if self._ps is not None:
+            # only rank 0 pushes the initial weights, then everyone
+            # rendezvous (reference: kvstore_dist.h Init — rank 0 sends,
+            # Barrier() before anyone proceeds).  "First worker's init
+            # wins" races under structured initializers: a big array is
+            # split flat across shards, and two workers' interleaved
+            # per-shard inits can land slice i from worker A and slice
+            # j from worker B — a weight no worker ever held.
+            from .ndarray import gather_global
+
             keys, values = _key_value(key, value)
             for k, v in zip(keys, values):
-                arr = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
-                self._key_meta[k] = (arr.shape, arr.dtype)
-                self._ps.init(k, arr)  # first worker's init wins
+                d = v._data if isinstance(v, NDArray) else None
+                cross_sharded = (
+                    d is not None
+                    and not getattr(d, "is_fully_addressable", True)
+                    and not d.sharding.is_fully_replicated)
+                if cross_sharded:
+                    # lockstep gather: EVERY rank must participate in
+                    # the collective even though only rank 0 pushes
+                    arr = gather_global(v)
+                elif self.rank == 0:
+                    arr = (v.asnumpy() if isinstance(v, NDArray)
+                           else np.asarray(v))
+                else:
+                    arr = None
+                if self.rank == 0:
+                    self._key_meta[k] = (arr.shape, arr.dtype)
+                    self._ps.init(k, arr)
+                else:
+                    # metadata only — don't pay a D2H copy of every
+                    # weight on ranks whose value is discarded anyway.
+                    # The client still needs the flat size to plan the
+                    # same big-array split as rank 0's init.
+                    if isinstance(v, NDArray) or hasattr(v, "shape"):
+                        shape, dtype = tuple(v.shape), np.dtype(v.dtype)
+                    else:
+                        a = np.asarray(v)
+                        shape, dtype = a.shape, a.dtype
+                    self._key_meta[k] = (shape, dtype)
+                    self._ps.record_size(k, int(np.prod(shape)) if shape
+                                         else 1)
+            # the rendezvous (no pull/push before rank 0's init landed)
+            # is deferred to the first non-init op: Module init calls
+            # init() once per parameter, and a barrier per key would be
+            # hundreds of cross-host collectives at startup
+            self._needs_init_barrier = True
             return
         if jax.process_count() > 1:
             # sync path: rank 0's init wins for ALL workers (the
@@ -337,9 +379,15 @@ class DistKVStore(TPUKVStore):
             # the init contract (dup check, storage) lives in one place.
             from jax.experimental import multihost_utils
 
+            from .ndarray import gather_global
+
             keys, values = _key_value(key, value)
-            hosts = [v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
-                     for v in values]
+            # gather_global, not asnumpy: this is a lockstep site (every
+            # worker inits the same keys together), so gathering a
+            # sharded init value is legitimate here even though
+            # asnumpy() refuses to do it implicitly
+            hosts = [gather_global(v) if isinstance(v, NDArray)
+                     else np.asarray(v) for v in values]
             hosts = multihost_utils.broadcast_one_to_all(hosts)
             super().init(keys, [NDArray(jnp.asarray(np.asarray(h)))
                                 for h in hosts])
@@ -376,6 +424,7 @@ class DistKVStore(TPUKVStore):
         import jax
 
         if self._ps is not None:
+            self._init_barrier()
             # async: each push is applied by its shard the moment it
             # arrives — no cross-worker rendezvous of any kind.
             # server-sync: the shard accumulates NumWorkers pushes and
@@ -408,8 +457,17 @@ class DistKVStore(TPUKVStore):
             else:
                 stored._set_data(merged.astype(stored.dtype))
 
+    def _init_barrier(self):
+        """One rendezvous before the first post-init pull/push: rank
+        0's init must have landed on every shard before any worker
+        reads or updates (deferred from init(), which runs per key)."""
+        if self._needs_init_barrier:
+            self._needs_init_barrier = False
+            self.barrier()
+
     def pull(self, key, out=None, priority=0):
         if self._ps is not None:
+            self._init_barrier()
             assert out is not None
             keys, outs = _key_value_lists(key, out)
             for k, olist in zip(keys, outs):

@@ -206,8 +206,20 @@ def _qkv_infer(attrs, in_shapes):
     (s,) = in_shapes
     if s is None:
         return in_shapes, None, None
-    if len(s) != 3 or s[2] % 3:
-        raise MXNetError(f"QKVSelfAttention wants (B, T, 3*d); got {s}")
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    if len(s) != 3:
+        raise MXNetError(
+            f"QKVSelfAttention wants a 3-D qkv (B, T, 3*num_heads*d_head); "
+            f"got {s}")
+    if s[2] % (3 * H):
+        # catch the packing mismatch here, with the expected layout in
+        # the message — not as an opaque Pallas reshape failure later
+        raise MXNetError(
+            f"QKVSelfAttention: qkv last dim {s[2]} is not divisible by "
+            f"3*num_heads = 3*{H} = {3 * H}; expected packing is "
+            f"(B, T, 3*num_heads*d_head) laid out as contiguous thirds "
+            f"[q | k | v], each third holding all heads' d_head lanes "
+            f"(got shape {s})")
     return in_shapes, [(s[0], s[1], s[2] // 3)], []
 
 

@@ -103,7 +103,22 @@ class Predictor:
         self.output_names = symbol.list_outputs()
         self._out_shapes = [tuple(s) for s in out_shapes]
 
-        graph_fn = build_graph_fn(symbol)
+        self._graph_fn = build_graph_fn(symbol)
+        self._fn = jax.jit(self.forward_closure())
+        self._inputs = {}
+        self._outputs = None
+
+    def forward_closure(self):
+        """The pure inference function ``{input_name: array} -> outputs``
+        with the weights/aux closed over.
+
+        This is the unit the serving engine re-jits per batch bucket
+        (``serving.InferenceEngine``): the closure is shape-polymorphic,
+        so one Predictor bound at any batch size yields executables for
+        every bucket in the ladder without reloading weights."""
+        import jax
+
+        graph_fn = self._graph_fn
         weights = self._weights
         aux = self._aux
         key = jax.random.PRNGKey(0)
@@ -114,9 +129,7 @@ class Predictor:
             outs, _ = graph_fn(full, aux, key, False)
             return outs
 
-        self._fn = jax.jit(forward)
-        self._inputs = {}
-        self._outputs = None
+        return forward
 
     # -- reference-style workflow --------------------------------------
     def set_input(self, name, data):
@@ -193,6 +206,8 @@ def export_model(symbol, arg_params, aux_params, input_shapes, path=None,
     header = json.dumps({
         "inputs": input_names,
         "input_shapes": {n: list(input_shapes[n]) for n in input_names},
+        "input_dtypes": {n: np.dtype(input_dtypes.get(n, np.float32)).name
+                         for n in input_names},
         "outputs": symbol.list_outputs(),
     }).encode()
     blob = (_MAGIC + len(header).to_bytes(8, "little") + header

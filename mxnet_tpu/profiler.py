@@ -26,7 +26,9 @@ import time
 from contextlib import contextmanager
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "scope", "start_xla_trace", "stop_xla_trace", "Profiler"]
+           "scope", "add_event", "start_xla_trace", "stop_xla_trace",
+           "Profiler", "MetricsRegistry", "inc_counter", "observe",
+           "metrics_summary", "reset_metrics"]
 
 
 class Profiler:
@@ -116,6 +118,101 @@ def dump_profile(filename=None):
 def scope(name, cat="op"):
     """Span context manager used by framework internals; no-op when off."""
     return _profiler.scope(name, cat)
+
+
+def add_event(name, start_s, dur_s, cat="op"):
+    """Record a complete span with explicit timing — for spans whose
+    start and end live on different threads (e.g. serving dispatch →
+    completion).  No-op when profiling is off."""
+    _profiler.add_event(name, start_s, dur_s, cat)
+
+
+# -- counters / histograms ----------------------------------------------
+class MetricsRegistry:
+    """Lightweight serving/runtime metrics: named monotonic counters and
+    bounded-reservoir histograms with percentile queries.
+
+    This is the always-on companion to the span profiler above: spans
+    answer "where did this program unit's time go", the registry
+    answers "what are the steady-state rates and tails" (queue depth,
+    batch-fill ratio, request latency) without requiring a trace to be
+    running.  Thread-safe; the serving engine hammers it from three
+    threads."""
+
+    def __init__(self, reservoir=65536):
+        import collections
+
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._hists = {}
+        self._deque = collections.deque
+        self._reservoir = reservoir
+
+    def inc(self, name, value=1.0):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def observe(self, name, value):
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                # (reservoir of last N, lifetime count, lifetime sum) —
+                # percentiles come from the reservoir, count/mean are
+                # exact over the full lifetime
+                h = self._hists[name] = [
+                    self._deque(maxlen=self._reservoir), 0, 0.0]
+            h[0].append(float(value))
+            h[1] += 1
+            h[2] += float(value)
+
+    def summary(self):
+        """→ {'counters': {...}, 'histograms': {name: {count, mean,
+        min, max, p50, p99}}} — JSON-ready."""
+        import numpy as _np
+
+        with self._lock:
+            counters = dict(self._counters)
+            hists = {k: (_np.asarray(h[0], dtype=_np.float64), h[1], h[2])
+                     for k, h in self._hists.items()}
+        out = {"counters": counters, "histograms": {}}
+        for k, (vals, count, total) in hists.items():
+            if not len(vals):
+                continue
+            out["histograms"][k] = {
+                "count": int(count),
+                "mean": float(total / count),
+                "min": float(vals.min()), "max": float(vals.max()),
+                "p50": float(_np.percentile(vals, 50)),
+                "p99": float(_np.percentile(vals, 99)),
+            }
+        return out
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._hists.clear()
+
+
+_metrics = MetricsRegistry()
+
+
+def inc_counter(name, value=1.0):
+    """Bump a named monotonic counter (e.g. ``serving.requests``)."""
+    _metrics.inc(name, value)
+
+
+def observe(name, value):
+    """Record one histogram sample (e.g. ``serving.latency_ms``)."""
+    _metrics.observe(name, value)
+
+
+def metrics_summary():
+    """Counters + histogram stats (count/mean/min/max/p50/p99)."""
+    return _metrics.summary()
+
+
+def reset_metrics():
+    _metrics.reset()
 
 
 # -- XLA-level tracing (the per-kernel story) ---------------------------

@@ -166,9 +166,11 @@ def _body_init(key, value) -> bytes:
     return bytes([_INIT]) + _pack_key(key) + _pack_tensor(np.asarray(value))
 
 
-def _body_push(key, grad, sync: bool) -> bytes:
+def _body_push(key, grad, sync: bool, worker: int = 0) -> bytes:
+    # the worker id rides every push frame so the sync server can tell
+    # "all workers pushed" from "one worker pushed num_workers times"
     return (bytes([_PUSH_SYNC if sync else _PUSH]) + _pack_key(key)
-            + _pack_tensor(np.asarray(grad)))
+            + _U32.pack(worker) + _pack_tensor(np.asarray(grad)))
 
 
 def _body_pull(key, min_round: int) -> bytes:
@@ -195,7 +197,7 @@ class ParameterServer:
         self._applied: Dict[Any, int] = {}   # pushes applied (version)
         self._round: Dict[Any, int] = {}     # completed update rounds
         self._pending: Dict[Any, np.ndarray] = {}
-        self._pending_n: Dict[Any, int] = {}
+        self._contrib: Dict[Any, set] = {}   # workers in the open round
         self._updater = None
         self._secret = secret
         self._num_workers = num_workers
@@ -241,6 +243,8 @@ class ParameterServer:
                 return b"\x00"
             if op in (_PUSH, _PUSH_SYNC):
                 key, off = _unpack_key(buf, off)
+                (worker,) = _U32.unpack_from(buf, off)
+                off += 4
                 grad, _ = _unpack_tensor(buf, off)
                 with self._cond:
                     if key not in self._store:
@@ -248,18 +252,32 @@ class ParameterServer:
                     if op == _PUSH and not self._sync:
                         self._apply(key, grad)
                     else:
-                        # sync: merge; apply once all workers pushed
+                        # sync: merge; apply once ALL DISTINCT workers
+                        # pushed.  A duplicate push from a worker that
+                        # already contributed belongs to the NEXT round
+                        # — queue it (block this worker's handler thread
+                        # until the open round completes) rather than
+                        # letting it complete the round early with a
+                        # peer's gradient missing.
+                        ok = self._cond.wait_for(
+                            lambda: worker not in self._contrib.get(
+                                key, ()),
+                            timeout=600.0)
+                        if not ok:
+                            raise MXNetError(
+                                f"duplicate push({key}) from worker "
+                                f"{worker} timed out waiting for round "
+                                f"completion (a peer never pushed?)")
+                        self._contrib.setdefault(key, set()).add(worker)
                         if key in self._pending:
                             self._pending[key] = self._pending[key] + grad
-                            self._pending_n[key] += 1
                         else:
                             self._pending[key] = np.array(
                                 grad, dtype=np.float64
                                 if grad.dtype == np.float64 else np.float32)
-                            self._pending_n[key] = 1
-                        if self._pending_n[key] >= self._num_workers:
+                        if len(self._contrib[key]) >= self._num_workers:
+                            del self._contrib[key]  # open the next round
                             self._apply(key, self._pending.pop(key))
-                            del self._pending_n[key]
                 return b"\x00"
             if op == _PULL:
                 key, off = _unpack_key(buf, off)
@@ -346,13 +364,21 @@ class ParameterServer:
 # ---------------------------------------------------------------------------
 
 
+_WORKER_IDS = iter(range(1 << 31, 1 << 32))  # auto ids, above real ranks
+
+
 class PSClient:
     """One persistent connection to one server shard (thread-safe)."""
 
     def __init__(self, host: str, port: int, secret: bytes = b"",
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, worker: Optional[int] = None):
         self._addr = (host, port)
         self._secret = secret
+        # worker identity rides every push frame (sync round tracking).
+        # Default: unique per client object, so N independent clients
+        # are N distinct workers (the pre-tracking behavior); pass an
+        # explicit id to make retries/reconnects count as one worker.
+        self._worker = next(_WORKER_IDS) if worker is None else worker
         self._lock = threading.Lock()
         import time
 
@@ -404,10 +430,10 @@ class PSClient:
         self._call(_body_init(key, value))
 
     def push(self, key, grad: np.ndarray):
-        self._call(_body_push(key, grad, sync=False))
+        self._call(_body_push(key, grad, sync=False, worker=self._worker))
 
     def push_sync(self, key, grad: np.ndarray):
-        self._call(_body_push(key, grad, sync=True))
+        self._call(_body_push(key, grad, sync=True, worker=self._worker))
 
     def pull(self, key, min_round: int = 0) -> np.ndarray:
         resp = self._call(_body_pull(key, min_round))
@@ -443,8 +469,12 @@ class ShardedPSClient:
     EncodeKey scheme, kvstore_dist.h:264-302)."""
 
     def __init__(self, addrs: Sequence[Tuple[str, int]],
-                 secret: bytes = b"", big_bound: Optional[int] = None):
-        self.clients = [PSClient(h, p, secret) for h, p in addrs]
+                 secret: bytes = b"", big_bound: Optional[int] = None,
+                 worker: Optional[int] = None):
+        if worker is None:
+            worker = next(_WORKER_IDS)  # ONE identity across all shards
+        self.clients = [PSClient(h, p, secret, worker=worker)
+                        for h, p in addrs]
         self.big_bound = bigarray_bound() if big_bound is None else big_bound
         # key → total flat size, recorded at init: num_applied and
         # shape-less pulls must plan the same split init/push used
@@ -501,6 +531,12 @@ class ShardedPSClient:
             raise first_err
         return results
 
+    def record_size(self, key, size: int):
+        """Register a key's flat size without pushing data — for ranks
+        that skip the actual init (rank-0-only init protocol) but still
+        need num_applied()/shape-less pull() to plan the same split."""
+        self._sizes[key] = int(size)
+
     def init(self, key, value: np.ndarray):
         value = np.asarray(value)
         self._sizes[key] = value.size
@@ -515,7 +551,7 @@ class ShardedPSClient:
         flat = grad.reshape(-1)
         self._fan_out([
             (cl, _body_push(wk, flat[a:b] if (a, b) != (0, grad.size)
-                            else grad, sync), None)
+                            else grad, sync, worker=cl._worker), None)
             for cl, wk, a, b in self._plan(key, grad.size)])
 
     def push(self, key, grad: np.ndarray):

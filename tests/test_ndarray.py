@@ -146,3 +146,13 @@ def test_ndarray_waitall():
     mx.nd.waitall()
     b.wait_to_read()
     assert (b.asnumpy() == 2).all()
+
+
+def test_gather_global_local_fast_paths():
+    """gather_global: the explicit bulk-synchronous collective that
+    asnumpy() refuses to hide.  Single-process arrays are fully
+    addressable, so both fast paths must return without communication."""
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_array_equal(mx.nd.gather_global(a), a.asnumpy())
+    np.testing.assert_array_equal(mx.nd.gather_global(np.ones(3)),
+                                  np.ones(3))

@@ -98,8 +98,10 @@ def test_sync_round_applies_once_after_all_workers():
     136-198)."""
     servers, _ = _cluster(n=1, sync=True, num_workers=2)
     try:
-        w0 = PSClient("127.0.0.1", servers[0].port, secret=b"s3cret")
-        w1 = PSClient("127.0.0.1", servers[0].port, secret=b"s3cret")
+        w0 = PSClient("127.0.0.1", servers[0].port, secret=b"s3cret",
+                      worker=0)
+        w1 = PSClient("127.0.0.1", servers[0].port, secret=b"s3cret",
+                      worker=1)
         w0.init("w", np.zeros(4, np.float32))
         w1.init("w", np.ones(4, np.float32))  # later init is a no-op
         w0.set_optimizer(mx.optimizer.SGD(learning_rate=0.5,
@@ -126,6 +128,50 @@ def test_sync_round_applies_once_after_all_workers():
         assert servers[0]._applied["w"] == 1  # applied ONCE, not twice
         w0.close()
         w1.close()
+    finally:
+        [s.close() for s in servers]
+
+
+def test_sync_duplicate_push_joins_next_round():
+    """A worker double-pushing must NOT complete the round in place of
+    its peer: the duplicate queues for the next round, so the round
+    still waits for every distinct worker's gradient."""
+    import threading
+    import time
+
+    servers, _ = _cluster(n=1, sync=True, num_workers=2)
+    try:
+        w0 = PSClient("127.0.0.1", servers[0].port, secret=b"s3cret",
+                      worker=0)
+        w0b = PSClient("127.0.0.1", servers[0].port, secret=b"s3cret",
+                       worker=0)  # same worker, second connection
+        w1 = PSClient("127.0.0.1", servers[0].port, secret=b"s3cret",
+                      worker=1)
+        w0.init("w", np.zeros(4, np.float32))
+        w0.push_sync("w", np.ones(4, np.float32))
+        dup_done = threading.Event()
+
+        def dup():
+            w0b.push_sync("w", 8 * np.ones(4, np.float32))  # duplicate
+            dup_done.set()
+
+        t = threading.Thread(target=dup, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        # the duplicate is queued, NOT merged: round 1 has not applied
+        assert servers[0]._applied.get("w", 0) == 0
+        assert not dup_done.is_set()
+        w1.push_sync("w", 2 * np.ones(4, np.float32))  # completes round 1
+        # no updater installed: round 1 assigns the sum of w0+w1 only
+        np.testing.assert_allclose(w0.pull("w", min_round=1),
+                                   3 * np.ones(4), rtol=1e-6)
+        assert dup_done.wait(10)  # duplicate unblocked into round 2
+        w1.push_sync("w", np.zeros(4, np.float32))  # completes round 2
+        np.testing.assert_allclose(w0.pull("w", min_round=2),
+                                   8 * np.ones(4), rtol=1e-6)
+        assert servers[0]._applied["w"] == 2
+        for cl in (w0, w0b, w1):
+            cl.close()
     finally:
         [s.close() for s in servers]
 

@@ -1,0 +1,320 @@
+"""InferenceEngine tests: bucket-cache behavior, padding correctness,
+flush policy, concurrent-client correctness, export serving.
+
+All CPU-fast (small MLP): the smoke path the tier-1 gate runs."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def _mlp_predictor(batch=1, with_label=False, seed=0):
+    """Tiny MLP Predictor (logits head — no label input unless asked)."""
+    net = mx.sym.FullyConnected(
+        mx.sym.Activation(
+            mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                  num_hidden=16, name="fc1"),
+            act_type="relu"),
+        num_hidden=4, name="fc2")
+    if with_label:
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+    shapes = {"data": (batch, 6)}
+    if with_label:
+        shapes["softmax_label"] = (batch,)
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=["softmax_label"] if with_label else [])
+    mod.bind(data_shapes=[("data", (2, 6))],
+             label_shapes=[("softmax_label", (2,))] if with_label else None,
+             for_training=False)
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+    arg, aux = mod.get_params()
+    return mx.Predictor(net, {**arg, **aux}, shapes), net, (arg, aux)
+
+
+def _per_request_ref(pred_b1, X, label=None):
+    """Reference outputs: each sample alone through a batch-1 forward."""
+    outs = []
+    for i in range(len(X)):
+        kwargs = {"data": X[i:i + 1]}
+        if label is not None:
+            kwargs["softmax_label"] = label[i:i + 1]
+        pred_b1.forward(**kwargs)
+        outs.append(pred_b1.get_output(0))
+    return np.concatenate(outs, axis=0)
+
+
+def test_bucket_cache_compiles_each_bucket_at_most_once():
+    pred, _, _ = _mlp_predictor()
+    rng = np.random.RandomState(1)
+    with mx.InferenceEngine(pred, buckets=(1, 4, 8),
+                            batch_timeout_ms=1.0) as eng:
+        # hammer two bucket sizes repeatedly
+        for _ in range(6):
+            eng.infer(rng.randn(1, 6).astype(np.float32))
+        for _ in range(6):
+            eng.infer(rng.randn(3, 6).astype(np.float32))  # pads to 4
+        st = eng.stats()
+    assert st["compiles"] == {1: 1, 4: 1}, st["compiles"]
+    assert st["cache_hits"] >= 10
+    assert st["cache_misses"] == 2
+
+
+def test_prewarm_compiles_everything_up_front():
+    pred, _, _ = _mlp_predictor()
+    eng = mx.InferenceEngine(pred, buckets=(1, 4), prewarm=True)
+    try:
+        assert eng.stats()["compiles"] == {1: 1, 4: 1}
+        eng.infer(np.zeros((1, 6), np.float32))
+        assert eng.stats()["compiles"] == {1: 1, 4: 1}  # no recompiles
+    finally:
+        eng.close()
+
+
+def test_padding_rows_do_not_leak_into_real_outputs():
+    """A 3-sample request pads to bucket 4; the real rows must be
+    bit-identical no matter WHAT the pad lane holds — proven by running
+    the engine's own bucket executable with zero pad vs garbage pad.
+    (Bit-exactness across *different* executables — batch-4 vs batch-1
+    programs — is not an XLA guarantee; row independence within one
+    executable is what padding correctness requires.)"""
+    from mxnet_tpu.io import stage_array
+
+    pred, _, _ = _mlp_predictor()
+    rng = np.random.RandomState(2)
+    X = rng.randn(3, 6).astype(np.float32)
+    with mx.InferenceEngine(pred, buckets=(4,), batch_timeout_ms=1.0) as eng:
+        (out,) = eng.infer(X)
+        assert out.shape == (3, 4)
+        exe = eng._cache[4]
+        dev = eng._model.device
+        zero_pad = np.zeros((4, 6), np.float32)
+        zero_pad[:3] = X
+        junk_pad = np.full((4, 6), 1e6, np.float32)
+        junk_pad[:3] = X
+        a = np.asarray(exe({"data": stage_array(zero_pad, dev)})[0])
+        b = np.asarray(exe({"data": stage_array(junk_pad, dev)})[0])
+    np.testing.assert_array_equal(out, a[:3])  # engine == its executable
+    np.testing.assert_array_equal(a[:3], b[:3])  # pad content can't leak
+    # numerical sanity vs the per-request batch-1 program
+    np.testing.assert_allclose(out, _per_request_ref(pred, X),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_same_bucket_resubmission_is_deterministic():
+    """The cached executable is pure: the same request twice through the
+    same bucket returns bit-identical results."""
+    pred, _, _ = _mlp_predictor()
+    X = np.random.RandomState(6).randn(3, 6).astype(np.float32)
+    with mx.InferenceEngine(pred, buckets=(4,), batch_timeout_ms=1.0) as eng:
+        (a,) = eng.infer(X)
+        (b,) = eng.infer(X)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_full_batch_flush_vs_timeout_flush():
+    pred, _, _ = _mlp_predictor()
+    X = np.zeros((1, 6), np.float32)
+    # long timeout: 4 rapid singles coalesce into ONE full-batch flush —
+    # the deadline never fires because the batch fills first
+    with mx.InferenceEngine(pred, buckets=(4,), max_batch=4,
+                            batch_timeout_ms=10_000,
+                            idle_timeout_ms=10_000, prewarm=True) as eng:
+        futs = [eng.submit(X) for _ in range(4)]
+        for f in futs:
+            f.result(timeout=30)
+        st = eng.stats()
+        assert st["flush_full"] == 1 and st["flush_timeout"] == 0, st
+        assert st["batches"] == 1
+    # short timeout: a lone request leaves on the deadline path
+    with mx.InferenceEngine(pred, buckets=(4,), max_batch=4,
+                            batch_timeout_ms=20, idle_timeout_ms=20,
+                            prewarm=True) as eng:
+        t0 = time.perf_counter()
+        eng.infer(X)
+        waited = time.perf_counter() - t0
+        st = eng.stats()
+    assert st["flush_timeout"] == 1 and st["flush_full"] == 0, st
+    assert waited >= 0.02  # it did hold the deadline open
+
+
+def test_short_timeout_flushes_partial_batch():
+    pred, _, _ = _mlp_predictor()
+    with mx.InferenceEngine(pred, buckets=(8,), batch_timeout_ms=5,
+                            prewarm=True) as eng:
+        (out,) = eng.infer(np.zeros((2, 6), np.float32))
+        assert out.shape == (2, 4)
+        st = eng.stats()
+    assert st["flush_timeout"] == 1
+    assert st["batch_fill_ratio"] == pytest.approx(2 / 8)
+
+
+def test_concurrent_clients_bit_exact():
+    """N client threads × M single-sample requests: every result equals
+    the per-request batch-1 forward bit-exactly, regardless of how the
+    batcher coalesced/padded them."""
+    pred, _, _ = _mlp_predictor()
+    rng = np.random.RandomState(3)
+    N, M = 8, 12
+    X = rng.randn(N, M, 6).astype(np.float32)
+    results = {}
+    with mx.InferenceEngine(pred, buckets=(1, 4, 8, 16),
+                            batch_timeout_ms=2.0,
+                            idle_timeout_ms=2.0) as eng:
+        def client(c):
+            outs = []
+            for i in range(M):
+                outs.append(eng.infer(X[c, i:i + 1])[0])
+            results[c] = np.concatenate(outs, axis=0)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        st = eng.stats()
+    assert st["images"] == N * M
+    for c in range(N):
+        ref = _per_request_ref(pred, X[c])
+        # tight allclose, not array_equal: a request may land in any
+        # bucket and XLA's batch-1 vs batch-8 programs round differently
+        # in the last ulp (see the padding test for the bit-exact
+        # same-executable guarantee)
+        np.testing.assert_allclose(results[c], ref, rtol=2e-6, atol=2e-6)
+    # dynamic batching actually batched: fewer dispatches than requests
+    assert st["batches"] < N * M
+    # each bucket compiled at most once, whatever mix of sizes ran
+    assert all(v == 1 for v in st["compiles"].values()), st["compiles"]
+
+
+def test_multi_input_requests_and_label_input():
+    pred, _, _ = _mlp_predictor(with_label=True)
+    rng = np.random.RandomState(4)
+    X = rng.randn(2, 6).astype(np.float32)
+    lab = np.zeros((2,), np.float32)
+    with mx.InferenceEngine(pred, buckets=(4,), batch_timeout_ms=1.0) as eng:
+        (out,) = eng.infer({"data": X, "softmax_label": lab})
+    assert out.shape == (2, 4)
+    ref = _per_request_ref(pred, X, label=lab)
+    np.testing.assert_allclose(out, ref, rtol=2e-6, atol=2e-6)
+
+
+def test_submit_validation_errors():
+    pred, _, _ = _mlp_predictor()
+    eng = mx.InferenceEngine(pred, buckets=(1, 4))
+    try:
+        with pytest.raises(mx.MXNetError, match="shape"):
+            eng.submit(np.zeros((1, 7), np.float32))
+        with pytest.raises(mx.MXNetError, match="max_batch"):
+            eng.submit(np.zeros((5, 6), np.float32))
+        with pytest.raises(mx.MXNetError, match="empty"):
+            eng.submit(np.zeros((0, 6), np.float32))
+        with pytest.raises(mx.MXNetError, match="bucket"):
+            mx.InferenceEngine(pred, buckets=(4,), max_batch=8)
+    finally:
+        eng.close()
+    with pytest.raises(mx.MXNetError, match="closed"):
+        eng.submit(np.zeros((1, 6), np.float32))
+
+
+def test_bare_sample_auto_batches():
+    pred, _, _ = _mlp_predictor()
+    with mx.InferenceEngine(pred, buckets=(1,),
+                            batch_timeout_ms=1.0) as eng:
+        (out,) = eng.infer(np.zeros((6,), np.float32))  # per-sample shape
+    assert out.shape == (1, 4)
+
+
+def test_serving_exported_artifact(tmp_path):
+    """from_exported: single frozen bucket, everything pads to it."""
+    pred, net, (arg, aux) = _mlp_predictor()
+    path = str(tmp_path / "m.mxtpu")
+    mx.predictor.export_model(net, arg, aux, {"data": (4, 6)}, path=path)
+    rng = np.random.RandomState(5)
+    X = rng.randn(2, 6).astype(np.float32)
+    with mx.InferenceEngine.from_exported(path,
+                                          batch_timeout_ms=1.0) as eng:
+        assert eng.stats()["buckets"] == [4]
+        (out,) = eng.infer(X)
+    assert out.shape == (2, 4)
+    ref = _per_request_ref(pred, X)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_metrics_surface_through_profiler():
+    profiler.reset_metrics()
+    pred, _, _ = _mlp_predictor()
+    with mx.InferenceEngine(pred, buckets=(1,),
+                            batch_timeout_ms=1.0) as eng:
+        eng.infer(np.zeros((1, 6), np.float32))
+    summ = profiler.metrics_summary()
+    assert summ["counters"]["serving.requests"] >= 1
+    assert summ["counters"]["serving.images"] >= 1
+    lat = summ["histograms"]["serving.latency_ms"]
+    assert lat["count"] >= 1 and lat["p99"] >= lat["p50"] > 0
+    fill = summ["histograms"]["serving.batch_fill"]
+    assert 0 < fill["mean"] <= 1
+
+
+def test_batch_reducing_output_fails_loudly():
+    """An output that reduces over the batch axis can't be sliced back
+    per-request — the engine must fail the futures, not hand one client
+    a value computed over another client's rows."""
+    net = mx.sym.sum(mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                           num_hidden=4, name="fc"))
+    mod = mx.mod.Module(net, context=mx.cpu(), label_names=[])
+    mod.bind(data_shapes=[("data", (2, 6))], for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    arg, aux = mod.get_params()
+    pred = mx.Predictor(net, {**arg, **aux}, {"data": (1, 6)})
+    with mx.InferenceEngine(pred, buckets=(4,), batch_timeout_ms=1.0) as eng:
+        fut = eng.submit(np.ones((1, 6), np.float32))
+        with pytest.raises(mx.MXNetError, match="batch axis"):
+            fut.result(timeout=30)
+
+
+def test_boundary_flush_cost_model():
+    """The learned per-bucket cost model: grow across a bucket boundary
+    only when the measured rate of the bigger bucket wins; always grow
+    (explore) when the bigger bucket has never been measured."""
+    pred, _, _ = _mlp_predictor()
+    eng = mx.InferenceEngine(pred, buckets=(8, 32))
+    try:
+        # CPU-like scaling: b32 costs ~4x b8 — a 9th sample with an
+        # empty backlog projects 9/190 img/ms < 8/50: flush at 8
+        eng._bucket_ms = {8: 50.0, 32: 190.0}
+        assert eng._boundary_flush(8, 1)
+        # TPU-like flat cost: the bigger bucket is nearly free — grow
+        eng._bucket_ms = {8: 50.0, 32: 55.0}
+        assert not eng._boundary_flush(8, 1)
+        # bigger bucket never measured: explore (also compiles it)
+        eng._bucket_ms = {8: 50.0}
+        assert not eng._boundary_flush(8, 1)
+        # not at a boundary: adding stays inside the current bucket
+        eng._bucket_ms = {8: 50.0, 32: 190.0}
+        assert not eng._boundary_flush(4, 1)
+    finally:
+        eng.close()
+
+
+def test_boundary_flush_reason_counted():
+    """End-to-end: with a poisoned cost model making the big bucket look
+    terrible, coalescing two requests across the boundary flushes the
+    first at its bucket and counts a 'boundary' flush."""
+    pred, _, _ = _mlp_predictor()
+    with mx.InferenceEngine(pred, buckets=(2, 32), max_batch=32,
+                            batch_timeout_ms=10_000,
+                            idle_timeout_ms=500,
+                            prewarm=True) as eng:
+        eng._bucket_ms = {2: 1.0, 32: 1e6}  # never worth growing
+        f1 = eng.submit(np.zeros((2, 6), np.float32))   # fills bucket 2
+        f2 = eng.submit(np.zeros((1, 6), np.float32))   # would cross
+        f1.result(timeout=30)  # flushed at the boundary, not the 10s deadline
+        st = eng.stats()
+        assert st["flush_boundary"] >= 1, st
+        f2.result(timeout=30)  # the carried request still gets served
