@@ -85,6 +85,19 @@ register_env(
     "1: start the Chrome-trace profiler at import "
     "(reference: env_var.md MXNET_PROFILER_AUTOSTART).")
 register_env(
+    "MXNET_PROFILER_NO_AUTOSTART", 0, int,
+    "1: ignore MXNET_PROFILER_AUTOSTART — lets test suites and "
+    "embedding apps import the package without an env var flipping "
+    "global profiler state.")
+register_env(
+    "MXNET_WATCHDOG_DEADLINE", 60.0, float,
+    "Seconds a kvstore barrier or a parameter-server sync round may "
+    "stay open before the straggler watchdog logs which ranks have "
+    "arrived and which are late (instead of hanging silently).  0 "
+    "disables.  Naming ranks at a barrier needs the launcher's SHARED "
+    "MXNET_KVSTORE_HEARTBEAT_DIR (arrival stamps); without it the "
+    "timeout is still reported, anonymously.")
+register_env(
     "MXNET_COORDINATOR", None, str,
     "host:port of the JAX coordination service for multi-process "
     "(dist_*) runs.  Set by tools/launch.py; requires "

@@ -26,6 +26,7 @@ OpReqType (include/mxnet/op_attr_types.h).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -139,6 +140,52 @@ class Executor:
         self._train_snapshot = None
         self._cached_grads = None
         self._internals_fns: Dict[bool, Any] = {}
+        # programs this executor has already run once: first run per
+        # tag = trace+compile+run (XLA caches after), telemetered as a
+        # compile event.  Shapes are fixed per executor, so a reshape
+        # (new Executor) naturally restarts the compile accounting.
+        self._warm_programs: set = set()
+        # live-buffer-bytes gauge: what this bind pinned on device
+        # (args + grads + aux); decremented when the executor dies so
+        # bucketed/reshaped executor churn shows up as a sawtooth.
+        # Arrays reused from a shared_exec donor (the bucketed shared
+        # arena) are the donor's storage — counting them again would
+        # overstate live memory by the bucket count.
+        import weakref
+
+        donor_ids = set()
+        if shared_exec is not None:
+            donor_ids = {id(x) for x in (
+                list(shared_exec.arg_dict.values())
+                + list(shared_exec.grad_dict.values())
+                + list(shared_exec.aux_dict.values()))}
+        self._buffer_bytes = sum(
+            int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+            for a in {id(x): x for x in (
+                list(self.arg_dict.values()) + list(self.grad_dict.values())
+                + list(self.aux_dict.values()))}.values()
+            if id(a) not in donor_ids)
+        # generation-stamped (returned by the increment itself, so the
+        # stamp is atomic with it): a decrement that outlives
+        # reset_metrics() must be dropped, not drive the gauge negative
+        gen = _prof.inc_gauge("executor.live_buffer_bytes",
+                              self._buffer_bytes)
+        weakref.finalize(self, _prof.inc_gauge,
+                         "executor.live_buffer_bytes", -self._buffer_bytes,
+                         gen=gen)
+
+    def _record_program(self, tag, start_s, dur_s, args=None):
+        """Telemeter one program dispatch: first run per tag counts as
+        the compile (trace+compile+run — XLA caches afterwards)."""
+        compiled = tag not in self._warm_programs
+        if compiled:
+            self._warm_programs.add(tag)
+        ev_args = {"program": tag}
+        if args:
+            ev_args.update(args)
+        _prof.record_program(
+            f"Executor.compile+{tag}" if compiled else f"Executor.{tag}",
+            start_s, dur_s, compiled, args=ev_args)
 
     # ------------------------------------------------------------------
     def _to_dict(self, values, names, what, allow_missing=False) -> Dict[str, NDArray]:
@@ -235,25 +282,27 @@ class Executor:
         if self._monitor_callback is not None:
             self._run_monitor(arg_vals, aux_vals, rng, is_train)
 
-        with _prof.scope("Executor.forward/train" if is_train
-                         else "Executor.forward", cat="exec"):
-            if is_train and self._grad_names and self._outputs_all_loss_heads():
-                # training step on a loss-head graph: run the single fused
-                # fwd+bwd program now and cache the grads — backward() then
-                # just writes them out, so fwd+bwd costs ONE program run
-                outs, new_aux, grads = self._jit_fused_ones(arg_vals, aux_vals, rng)
-                self._cached_grads = grads
+        t_start = time.perf_counter()
+        if is_train and self._grad_names and self._outputs_all_loss_heads():
+            # training step on a loss-head graph: run the single fused
+            # fwd+bwd program now and cache the grads — backward() then
+            # just writes them out, so fwd+bwd costs ONE program run
+            tag = "fused_fwd_bwd"
+            outs, new_aux, grads = self._jit_fused_ones(arg_vals, aux_vals, rng)
+            self._cached_grads = grads
+            self._train_snapshot = (arg_vals, aux_vals, rng)
+        else:
+            tag = "forward/train" if is_train else "forward"
+            fn = self._jit_fwd_train if is_train else self._jit_fwd
+            outs, new_aux = fn(arg_vals, aux_vals, rng)
+            if is_train and self._grad_names:
+                # stash the *pristine* inputs + rng so a later
+                # backward(out_grads) reproduces this forward exactly
+                # (same dropout masks, same pre-update aux)
                 self._train_snapshot = (arg_vals, aux_vals, rng)
-            else:
-                fn = self._jit_fwd_train if is_train else self._jit_fwd
-                outs, new_aux = fn(arg_vals, aux_vals, rng)
-                if is_train and self._grad_names:
-                    # stash the *pristine* inputs + rng so a later
-                    # backward(out_grads) reproduces this forward exactly
-                    # (same dropout masks, same pre-update aux)
-                    self._train_snapshot = (arg_vals, aux_vals, rng)
-            if _prof._profiler.running:
-                jax.block_until_ready(outs)  # real span, not dispatch time
+        if _prof._profiler.running:
+            jax.block_until_ready(outs)  # real span, not dispatch time
+        self._record_program(tag, t_start, time.perf_counter() - t_start)
         for name, val in new_aux.items():
             self.aux_dict[name]._set_data(val)
         self.outputs_cache = [NDArray(o, self._ctx) for o in outs]
@@ -292,10 +341,12 @@ class Executor:
                 raise MXNetError(
                     f"out_grads has {len(heads)} entries for "
                     f"{len(self.output_names)} outputs")
-            with _prof.scope("Executor.backward", cat="exec"):
-                _, _, grads = self._jit_fused(arg_vals, aux_vals, rng, heads)
-                if _prof._profiler.running:
-                    jax.block_until_ready(grads)
+            t_start = time.perf_counter()
+            _, _, grads = self._jit_fused(arg_vals, aux_vals, rng, heads)
+            if _prof._profiler.running:
+                jax.block_until_ready(grads)
+            self._record_program("backward", t_start,
+                                 time.perf_counter() - t_start)
         for name in self._grad_names:
             g = grads[name]
             dst = self.grad_dict[name]

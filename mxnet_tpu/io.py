@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from . import profiler as _prof
 from .base import MXNetError
 from .ndarray import NDArray, array
 
@@ -48,6 +49,10 @@ def stage_array(arr, device):
         arr = arr._data
     elif not isinstance(arr, np.ndarray) and not hasattr(arr, "devices"):
         arr = np.asarray(arr)
+    # count only genuine host→device traffic: a jax array input is
+    # already device-resident and device_put moves no bytes over the bus
+    if isinstance(arr, np.ndarray) and arr.nbytes:
+        _prof.inc_counter("io.h2d_bytes", float(arr.nbytes))
     return jax.device_put(arr, device)
 
 
@@ -411,7 +416,10 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         if self._epoch_done:
             return False  # stay at epoch end until reset() (never block)
-        items = [self._pop(i) for i in range(self.n_iter)]
+        # the wait span is the signal: near-zero = prefetch keeps up,
+        # ~batch time = the input pipeline is the bottleneck
+        with _prof.scope("io.prefetch_wait", "io"):
+            items = [self._pop(i) for i in range(self.n_iter)]
         ends = [it is PrefetchingIter._END for it in items]
         if any(ends):
             assert all(ends), "entry-count mismatch between prefetched iterators"

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import profiler as _prof
 from .base import MXNetError
 from .ndarray import NDArray
 from . import optimizer as opt
@@ -155,6 +156,19 @@ def _maybe_init_distributed(kv_type: str):
     kvstore_dist.h:33-38 — connect or die)."""
     import logging
     import os
+
+    # tools/launch.py asks for gloo CPU collectives via the
+    # JAX_CPU_COLLECTIVES_IMPLEMENTATION env var, but jax's enum *flag*
+    # (unlike its config *states*) never reads the environment — so
+    # multi-process CPU runs die with "Multiprocess computations aren't
+    # implemented on the CPU backend".  Push the env var into the
+    # config before the backend client is created.
+    impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION")
+    if impl:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", impl)
+        except Exception:  # noqa: BLE001 — flag renamed/absent in other
+            pass           # jax versions that DO read the env var
 
     coord = os.environ.get("MXNET_COORDINATOR")
     kwargs = {}
@@ -433,11 +447,19 @@ class DistKVStore(TPUKVStore):
             for k, vlist in zip(keys, values):
                 merged = vlist[0]._data if len(vlist) == 1 else _tree_sum(
                     tuple(v._data for v in vlist))
-                if self._server_sync:
-                    self._sync_round[k] = self._sync_round.get(k, 0) + 1
-                    self._ps.push_sync(k, np.asarray(merged))
-                else:
-                    self._ps.push(k, np.asarray(merged))
+                # the D2H materialization is part of the push cost the
+                # span exists to measure — keep it inside the scope
+                with _prof.scope("kvstore.push", "comm",
+                                 args={"key": str(k),
+                                       "bytes": int(getattr(merged,
+                                                            "nbytes", 0)),
+                                       "sync": self._server_sync}):
+                    host = np.asarray(merged)
+                    if self._server_sync:
+                        self._sync_round[k] = self._sync_round.get(k, 0) + 1
+                        self._ps.push_sync(k, host)
+                    else:
+                        self._ps.push(k, host)
             return
         if jax.process_count() == 1:
             return super().push(key, value, priority)
@@ -449,8 +471,12 @@ class DistKVStore(TPUKVStore):
                 raise MXNetError(f"push to uninitialized key {k}")
             merged = vlist[0]._data if len(vlist) == 1 else _tree_sum(
                 tuple(v._data for v in vlist))
-            gathered = multihost_utils.process_allgather(merged)
-            merged = jnp.sum(gathered, axis=0)
+            with _prof.scope("kvstore.push.allreduce", "comm",
+                             args={"key": str(k),
+                                   "bytes": int(getattr(merged, "nbytes",
+                                                        0))}):
+                gathered = multihost_utils.process_allgather(merged)
+                merged = jnp.sum(gathered, axis=0)
             stored = self._store[k]
             if self._updater is not None:
                 self._updater(k, NDArray(merged), stored)
@@ -474,10 +500,13 @@ class DistKVStore(TPUKVStore):
                 shape, dtype = self._key_meta.get(k, (None, None))
                 # async: current weights, no barrier.  server-sync:
                 # wait for the round this worker's pushes belong to
-                cur = self._ps.pull(
-                    k, shape=shape, dtype=dtype,
-                    min_round=self._sync_round.get(k, 0)
-                    if self._server_sync else 0)
+                with _prof.scope("kvstore.pull", "comm",
+                                 args={"key": str(k),
+                                       "sync": self._server_sync}):
+                    cur = self._ps.pull(
+                        k, shape=shape, dtype=dtype,
+                        min_round=self._sync_round.get(k, 0)
+                        if self._server_sync else 0)
                 for o in olist:
                     o._set_data(jnp.asarray(cur).astype(o.dtype))
             return
@@ -516,13 +545,99 @@ class DistKVStore(TPUKVStore):
 
     def barrier(self):
         """All-process rendezvous (reference: kvstore_dist.h Barrier →
-        ps::Postoffice barrier)."""
+        ps::Postoffice barrier) — with a straggler watchdog.
+
+        Each rank stamps an arrival file in the heartbeat dir before
+        entering the collective; a timer fires after
+        MXNET_WATCHDOG_DEADLINE seconds and logs which ranks have
+        arrived and which are late — a hung multi-worker job then says
+        *who* is stuck instead of hanging silently."""
+        import os
+        import threading
+        import time
+
         import jax
 
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+        if jax.process_count() <= 1:
+            return
+        from jax.experimental import multihost_utils
 
+        from .base import get_env
+
+        self._barrier_seq = getattr(self, "_barrier_seq", 0) + 1
+        seq = self._barrier_seq
+        deadline = get_env("MXNET_WATCHDOG_DEADLINE", 60.0, float)
+        watch = None
+        stamp = None
+        done = threading.Event()
+        if deadline > 0:  # 0 disables the watchdog
+            # arrival stamps need the launcher's SHARED heartbeat dir to
+            # name ranks; without it the watchdog still reports the
+            # timeout, just anonymously
+            if self._hb_dir:
+                # clean our PREVIOUS stamp only now: removing it on
+                # barrier exit would race a slower peer's deadline scan
+                # of the SAME barrier and accuse this (arrived) rank
+                try:
+                    os.remove(os.path.join(
+                        self._hb_dir, f"barrier_{seq - 1}_{self.rank}"))
+                except OSError:
+                    pass
+                stamp = os.path.join(self._hb_dir,
+                                     f"barrier_{seq}_{self.rank}")
+                try:
+                    with open(stamp, "w") as f:
+                        f.write(str(time.time()))
+                except OSError:
+                    stamp = None
+            watch = threading.Timer(
+                deadline, self._report_stragglers,
+                args=(seq, deadline, done))
+            watch.daemon = True
+            watch.start()
+        t0 = time.perf_counter()
+        try:
             multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")
+        finally:
+            # the stamp stays on disk until the NEXT barrier's entry: a
+            # peer still inside THIS barrier may scan the dir at its
+            # deadline, and a missing stamp would falsely accuse us
+            done.set()
+            if watch is not None:
+                watch.cancel()
+            _prof.add_event("kvstore.barrier", t0,
+                            time.perf_counter() - t0, "comm",
+                            args={"seq": seq})
+            _prof.observe("kvstore.barrier_ms",
+                          (time.perf_counter() - t0) * 1e3)
+
+    def _report_stragglers(self, seq, deadline, done):
+        """Watchdog body: name the ranks whose arrival stamp for
+        barrier ``seq`` is missing after ``deadline`` seconds."""
+        import logging
+        import os
+
+        if done.is_set():  # barrier completed while the timer fired
+            return
+        if not self._hb_dir:
+            logging.warning(
+                "[watchdog] kvstore barrier #%d open for %.1fs on rank "
+                "%d (no shared MXNET_KVSTORE_HEARTBEAT_DIR — cannot "
+                "name arrivals; use tools/launch.py to get one)",
+                seq, deadline, self.rank)
+            _prof.inc_counter("watchdog.barrier_timeouts")
+            return
+        arrived, missing = [], []
+        for r in range(self.num_workers):
+            path = os.path.join(self._hb_dir, f"barrier_{seq}_{r}")
+            (arrived if os.path.exists(path) else missing).append(r)
+        if done.is_set():  # completed mid-scan: stamps are half-removed
+            return
+        logging.warning(
+            "[watchdog] kvstore barrier #%d open for %.1fs on rank %d: "
+            "arrived ranks %s, waiting on ranks %s",
+            seq, deadline, self.rank, arrived, missing)
+        _prof.inc_counter("watchdog.barrier_timeouts")
 
     def get_num_dead_node(self, node_id=0, timeout=60):
         """Count workers whose heartbeat file is stale (reference:

@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import metric as metric_mod
+from .. import profiler as _prof
 from ..base import MXNetError
 from ..model import BatchEndParam
 from ..ndarray import NDArray
@@ -145,11 +146,25 @@ class BaseModule:
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
+            # manual iteration so the step timeline can split "waiting
+            # on the input pipeline" (io.next) from the training step
+            # itself (fit.step) — the two spans every per-step perf
+            # question starts from
+            train_iter = iter(train_data)
+            nbatch = 0
+            while True:
+                with _prof.scope("io.next", "io",
+                                 args={"epoch": epoch, "step": nbatch}):
+                    try:
+                        data_batch = next(train_iter)
+                    except StopIteration:
+                        break
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                with _prof.scope("fit.step", "step",
+                                 args={"epoch": epoch, "step": nbatch}):
+                    self.forward_backward(data_batch)
+                    self.update()
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
@@ -159,6 +174,7 @@ class BaseModule:
                                                      locals=locals())
                     for callback in _as_list(batch_end_callback):
                         callback(batch_end_params)
+                nbatch += 1
 
             # one epoch of training is finished
             for name, val in eval_metric.get_name_value():

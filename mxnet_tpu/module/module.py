@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import pickle
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -126,6 +127,7 @@ class Module(BaseModule):
 
         self._use_fused = _os.environ.get("MXNET_FUSED_STEP", "1") != "0"
         self._fused_step = None
+        self._fused_warm = False  # first fused run = compile (telemetry)
         self._fused_state = None
         self._pending_batch = None
         self._step_count = 0
@@ -601,11 +603,17 @@ class Module(BaseModule):
                 return
         param_arrays = [self._exec.arg_dict[n] for n in self._param_names]
         grad_arrays = [self._exec.grad_dict.get(n) for n in self._param_names]
-        if self._update_on_kvstore:
-            _update_params_on_kvstore(param_arrays, grad_arrays, self._kvstore)
-        else:
-            _update_params(param_arrays, grad_arrays, updater=self._updater,
-                           num_device=len(self._context), kvstore=self._kvstore)
+        with _prof.scope("Module.update", "exec",
+                         args={"step": self._step_count,
+                               "on_kvstore": bool(self._update_on_kvstore)}):
+            if self._update_on_kvstore:
+                _update_params_on_kvstore(param_arrays, grad_arrays,
+                                          self._kvstore)
+            else:
+                _update_params(param_arrays, grad_arrays,
+                               updater=self._updater,
+                               num_device=len(self._context),
+                               kvstore=self._kvstore)
 
     # -- fused one-program training step --------------------------------
     def _fused_ready(self):
@@ -820,13 +828,18 @@ class Module(BaseModule):
         params = _copy_donated_aliases(
             params, _buffer_ids(fixed, aux, inputs, self._fused_state,
                                 self._fused_key, self._fused_t))
-        with _prof.scope("Module.fused_step", cat="exec"):
-            outs, new_params, new_aux, new_states, self._fused_t = \
-                self._fused_step(params, fixed, aux, self._fused_state,
-                                 inputs, self._fused_key, lr_dev,
-                                 self._fused_t)
-            if _prof._profiler.running:
-                jax.block_until_ready(outs)
+        compiled = not self._fused_warm
+        self._fused_warm = True
+        t_start = time.perf_counter()
+        outs, new_params, new_aux, new_states, self._fused_t = \
+            self._fused_step(params, fixed, aux, self._fused_state,
+                             inputs, self._fused_key, lr_dev,
+                             self._fused_t)
+        if _prof._profiler.running:
+            jax.block_until_ready(outs)
+        _prof.record_program("Module.fused_step", t_start,
+                             time.perf_counter() - t_start, compiled,
+                             args={"step": self._step_count})
         for n, v in new_params.items():
             self._exec.arg_dict[n]._set_data(v)
         for n, v in new_aux.items():

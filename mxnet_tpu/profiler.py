@@ -1,4 +1,4 @@
-"""Profiler — Chrome-trace timing + XLA trace passthrough.
+"""Profiler — Chrome-trace timing, metrics registry, exporters.
 
 Capability parity with the reference profiler (``src/engine/
 profiler.h:20-130`` per-op stats dumped as Chrome tracing JSON,
@@ -11,9 +11,29 @@ TPU-first split: per-*kernel* timing lives in XLA, exposed by wrapping
 XPlane/TensorBoard trace — the modern equivalent of per-op stats);
 this module's own events time the *host-visible program units* the
 framework actually dispatches (forward / backward / fused step /
-update / io), which is the granularity a single-XLA-program design
-has.  Framework internals mark spans with ``profiler.scope(name)`` —
-a no-op when profiling is off.
+update / io / push / pull), which is the granularity a single-XLA-
+program design has.  Framework internals mark spans with
+``profiler.scope(name, cat, args=...)`` — a no-op when profiling is
+off; ``args`` (step number, bytes moved, bucket key) render in the
+trace viewer's detail pane.
+
+The observability layer on top (the Dapper-style "where did this STEP
+go, across every worker" question — Sigelman et al. 2010):
+
+* per-rank traces — every event carries this process's pid; ``dump``
+  adds Chrome ``M``-phase process metadata (rank name, sort index) and
+  a ``clock_sync`` anchor (wall-clock ↔ perf_counter captured
+  back-to-back) so ``tools/trace_merge.py`` can align traces from
+  different processes onto one wall-clock timeline viewable in
+  Perfetto.  ``dump_rank_trace(dir)`` writes ``trace_rank<N>.json``.
+* metrics registry — always-on counters / gauges / histograms
+  (``inc_counter`` / ``set_gauge`` / ``observe``); ``metrics_summary``
+  adds p50/p90/p99 and per-counter rate-since-reset so the serving
+  bench and the reporter share one schema.
+* exporters — ``prometheus_text()`` renders the registry in the
+  Prometheus text exposition format; ``start_reporter(path,
+  interval)`` appends a JSONL summary line every interval from a
+  daemon thread.
 """
 
 from __future__ import annotations
@@ -26,9 +46,24 @@ import time
 from contextlib import contextmanager
 
 __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
-           "scope", "add_event", "start_xla_trace", "stop_xla_trace",
-           "Profiler", "MetricsRegistry", "inc_counter", "observe",
-           "metrics_summary", "reset_metrics"]
+           "scope", "add_event", "record_program", "start_xla_trace",
+           "stop_xla_trace", "Profiler", "MetricsRegistry", "inc_counter",
+           "observe", "metrics_summary", "reset_metrics", "set_gauge",
+           "inc_gauge", "gauge_generation", "process_rank",
+           "dump_rank_trace", "prometheus_text", "start_reporter",
+           "Reporter"]
+
+
+def process_rank() -> int:
+    """This process's rank in a distributed run.
+
+    The launcher (tools/launch.py) exports MXNET_WORKER_ID before any
+    jax state exists, so the env var is authoritative and reading it
+    never forces backend initialization.  Single process → 0."""
+    try:
+        return int(os.environ.get("MXNET_WORKER_ID") or 0)
+    except ValueError:
+        return 0
 
 
 class Profiler:
@@ -40,7 +75,11 @@ class Profiler:
         self._running = False
         self._filename = "profile.json"
         self._mode = "symbolic"  # 'symbolic' | 'all' (reference modes)
+        # clock-sync anchor: the same instant on both clocks, so a
+        # merger can map this trace's perf_counter-relative ts onto the
+        # shared wall clock (NTP-level alignment across ranks)
         self._t0 = time.perf_counter()
+        self._wall0 = time.time()
 
     # -- control (reference: profiler.py profiler_set_config/state) ----
     def set_config(self, mode="symbolic", filename="profile.json"):
@@ -60,38 +99,64 @@ class Profiler:
         return self._running
 
     # -- event recording -----------------------------------------------
-    def add_event(self, name, start_s, dur_s, cat="op", tid=None):
+    def add_event(self, name, start_s, dur_s, cat="op", tid=None, args=None):
         if not self._running:
             return
+        ev = {
+            "name": name, "cat": cat, "ph": "X",
+            "ts": (start_s - self._t0) * 1e6, "dur": dur_s * 1e6,
+            "pid": os.getpid(),
+            "tid": tid if tid is not None else threading.get_ident(),
+        }
+        if args:
+            ev["args"] = dict(args)
         with self._lock:
-            self._events.append({
-                "name": name, "cat": cat, "ph": "X",
-                "ts": (start_s - self._t0) * 1e6, "dur": dur_s * 1e6,
-                "pid": os.getpid(),
-                "tid": tid if tid is not None else threading.get_ident(),
-            })
+            self._events.append(ev)
 
-    def scope(self, name, cat="op"):
+    def scope(self, name, cat="op", args=None):
         # shared null context when off: zero allocation on the hot path
         if not self._running:
             return _NULL_CTX
-        return self._span(name, cat)
+        return self._span(name, cat, args)
 
     @contextmanager
-    def _span(self, name, cat):
+    def _span(self, name, cat, args=None):
         start = time.perf_counter()
         try:
             yield
         finally:
-            self.add_event(name, start, time.perf_counter() - start, cat)
+            self.add_event(name, start, time.perf_counter() - start, cat,
+                           args=args)
 
     def dump(self, filename=None):
-        """Write accumulated events as Chrome tracing JSON."""
+        """Write accumulated events as Chrome tracing JSON.
+
+        The file carries process metadata ('M' events: rank name and
+        sort index) and a top-level ``metadata.clock_sync`` anchor so
+        tools/trace_merge.py can merge per-rank files onto one
+        wall-clock-aligned timeline."""
         filename = filename or self._filename
         with self._lock:
             events = list(self._events)
+        rank = process_rank()
+        pid = os.getpid()
+        meta_events = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"rank {rank}"}},
+            {"name": "process_sort_index", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"sort_index": rank}},
+        ]
         with open(filename, "w") as f:
-            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+            json.dump({
+                "traceEvents": meta_events + events,
+                "displayTimeUnit": "ms",
+                "metadata": {
+                    "rank": rank,
+                    "pid": pid,
+                    "clock_sync": {"wall_time_s": self._wall0,
+                                   "perf_counter_s": self._t0},
+                },
+            }, f)
         return filename
 
 
@@ -115,42 +180,103 @@ def dump_profile(filename=None):
     return _profiler.dump(filename)
 
 
-def scope(name, cat="op"):
-    """Span context manager used by framework internals; no-op when off."""
-    return _profiler.scope(name, cat)
+def dump_rank_trace(trace_dir):
+    """Write this process's trace as ``<trace_dir>/trace_rank<N>.json``.
+
+    Every distributed worker calls this with the same shared directory;
+    ``tools/trace_merge.py`` then merges the per-rank files into one
+    Perfetto-viewable timeline."""
+    os.makedirs(trace_dir, exist_ok=True)
+    return _profiler.dump(os.path.join(
+        trace_dir, f"trace_rank{process_rank()}.json"))
 
 
-def add_event(name, start_s, dur_s, cat="op"):
+def scope(name, cat="op", args=None):
+    """Span context manager used by framework internals; no-op when
+    off.  ``args`` (a small dict: step number, bytes, bucket key…)
+    renders in the trace viewer."""
+    return _profiler.scope(name, cat, args)
+
+
+def add_event(name, start_s, dur_s, cat="op", args=None):
     """Record a complete span with explicit timing — for spans whose
     start and end live on different threads (e.g. serving dispatch →
     completion).  No-op when profiling is off."""
-    _profiler.add_event(name, start_s, dur_s, cat)
+    _profiler.add_event(name, start_s, dur_s, cat, args=args)
 
 
-# -- counters / histograms ----------------------------------------------
+def record_program(name, start_s, dur_s, compiled, cat="exec", args=None):
+    """Telemeter one jitted-program dispatch — the ONE compile-
+    accounting contract shared by Executor and the Module fused step:
+    a first run (``compiled``) bumps the ``executor.compiles`` counter,
+    samples ``executor.compile_ms``, and tags the span cat='compile';
+    warm runs emit a plain exec span.  Every span carries the
+    ``compile`` flag in its args."""
+    if compiled:
+        inc_counter("executor.compiles")
+        observe("executor.compile_ms", dur_s * 1e3)
+    ev_args = {"compile": compiled}
+    if args:
+        ev_args.update(args)
+    _profiler.add_event(name, start_s, dur_s,
+                        "compile" if compiled else cat, args=ev_args)
+
+
+# -- counters / gauges / histograms -------------------------------------
 class MetricsRegistry:
-    """Lightweight serving/runtime metrics: named monotonic counters and
-    bounded-reservoir histograms with percentile queries.
+    """Lightweight serving/runtime metrics: named monotonic counters,
+    set/inc gauges, and bounded-reservoir histograms with percentile
+    queries.
 
     This is the always-on companion to the span profiler above: spans
     answer "where did this program unit's time go", the registry
     answers "what are the steady-state rates and tails" (queue depth,
-    batch-fill ratio, request latency) without requiring a trace to be
-    running.  Thread-safe; the serving engine hammers it from three
-    threads."""
+    batch-fill ratio, request latency, live buffer bytes) without
+    requiring a trace to be running.  Thread-safe; the serving engine
+    hammers it from three threads."""
 
     def __init__(self, reservoir=65536):
         import collections
 
         self._lock = threading.Lock()
         self._counters = {}
+        self._gauges = {}
         self._hists = {}
         self._deque = collections.deque
         self._reservoir = reservoir
+        self._t_reset = time.monotonic()
+        self._gen = 0
 
     def inc(self, name, value=1.0):
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0.0) + value
+            # float() so numpy scalars can't poison json.dumps later
+            self._counters[name] = self._counters.get(name, 0.0) \
+                + float(value)
+
+    def set_gauge(self, name, value):
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def inc_gauge(self, name, delta, gen=None):
+        """Adjust a gauge by ``delta``; returns the generation the
+        delta was applied under (or None if dropped).  Delta-tracked
+        gauges whose decrement may outlive a ``reset()`` (e.g. an
+        executor finalizer releasing live-buffer bytes) pass the
+        generation this method RETURNED for the increment: if a reset
+        already cleared the increment, the stale decrement is dropped
+        instead of driving the gauge negative forever.  The generation
+        is read under the same lock as the update, so an increment can
+        never be stamped with a generation it wasn't applied under."""
+        with self._lock:
+            if gen is not None and gen != self._gen:
+                return None
+            self._gauges[name] = self._gauges.get(name, 0.0) + float(delta)
+            return self._gen
+
+    @property
+    def generation(self):
+        """Bumped by every reset(); see inc_gauge."""
+        return self._gen
 
     def observe(self, name, value):
         with self._lock:
@@ -166,15 +292,26 @@ class MetricsRegistry:
             h[2] += float(value)
 
     def summary(self):
-        """→ {'counters': {...}, 'histograms': {name: {count, mean,
-        min, max, p50, p99}}} — JSON-ready."""
+        """→ {'counters': {...}, 'rates': {name: per-second since
+        reset}, 'gauges': {...}, 'histograms': {name: {count, mean,
+        min, max, p50, p90, p99}}, 'elapsed_s': ...} — JSON-ready.
+
+        The reporter's JSONL lines and ``serving.stats()``/
+        ``tools/bench_serving.py`` all consume this one schema."""
         import numpy as _np
 
         with self._lock:
             counters = dict(self._counters)
+            gauges = dict(self._gauges)
             hists = {k: (_np.asarray(h[0], dtype=_np.float64), h[1], h[2])
                      for k, h in self._hists.items()}
-        out = {"counters": counters, "histograms": {}}
+            elapsed = time.monotonic() - self._t_reset
+        out = {"counters": counters,
+               "rates": {k: v / max(elapsed, 1e-9)
+                         for k, v in counters.items()},
+               "gauges": gauges,
+               "histograms": {},
+               "elapsed_s": elapsed}
         for k, (vals, count, total) in hists.items():
             if not len(vals):
                 continue
@@ -183,6 +320,7 @@ class MetricsRegistry:
                 "mean": float(total / count),
                 "min": float(vals.min()), "max": float(vals.max()),
                 "p50": float(_np.percentile(vals, 50)),
+                "p90": float(_np.percentile(vals, 90)),
                 "p99": float(_np.percentile(vals, 99)),
             }
         return out
@@ -190,7 +328,10 @@ class MetricsRegistry:
     def reset(self):
         with self._lock:
             self._counters.clear()
+            self._gauges.clear()
             self._hists.clear()
+            self._t_reset = time.monotonic()
+            self._gen += 1  # invalidate pending delta-gauge decrements
 
 
 _metrics = MetricsRegistry()
@@ -201,18 +342,124 @@ def inc_counter(name, value=1.0):
     _metrics.inc(name, value)
 
 
+def set_gauge(name, value):
+    """Set a named gauge to an absolute value (e.g. queue depth)."""
+    _metrics.set_gauge(name, value)
+
+
+def inc_gauge(name, delta, gen=None):
+    """Adjust a named gauge by a delta (e.g. live buffer bytes on
+    executor alloc/free); returns the generation it applied under.
+    Pass that value back as ``gen`` for the matching decrement when it
+    may run after a ``reset_metrics()`` (see
+    MetricsRegistry.inc_gauge)."""
+    return _metrics.inc_gauge(name, delta, gen=gen)
+
+
+def gauge_generation():
+    """Current registry generation (bumped by reset_metrics)."""
+    return _metrics.generation
+
+
 def observe(name, value):
     """Record one histogram sample (e.g. ``serving.latency_ms``)."""
     _metrics.observe(name, value)
 
 
 def metrics_summary():
-    """Counters + histogram stats (count/mean/min/max/p50/p99)."""
+    """Counters (+rates), gauges, histogram stats (p50/p90/p99)."""
     return _metrics.summary()
 
 
 def reset_metrics():
     _metrics.reset()
+
+
+# -- exporters -----------------------------------------------------------
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def prometheus_text(registry: MetricsRegistry | None = None,
+                    prefix: str = "mxnet") -> str:
+    """Render the registry in the Prometheus text exposition format.
+
+    Counters export as ``counter``, gauges as ``gauge``, histograms as
+    ``summary`` (p50/p90/p99 quantiles + _count/_sum).  Serve it from
+    any HTTP handler, or dump it periodically next to the JSONL
+    reporter — both views read the same registry, so ``serving.*``
+    counters and the training gauges show up with no extra wiring."""
+    summ = (registry or _metrics).summary()
+    rank = process_rank()
+    lines = []
+    for k in sorted(summ["counters"]):
+        m = f"{prefix}_{_prom_name(k)}"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f'{m}{{rank="{rank}"}} {summ["counters"][k]:g}')
+    for k in sorted(summ["gauges"]):
+        m = f"{prefix}_{_prom_name(k)}"
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f'{m}{{rank="{rank}"}} {summ["gauges"][k]:g}')
+    for k in sorted(summ["histograms"]):
+        h = summ["histograms"][k]
+        m = f"{prefix}_{_prom_name(k)}"
+        lines.append(f"# TYPE {m} summary")
+        for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+            lines.append(f'{m}{{rank="{rank}",quantile="{q}"}} {h[key]:g}')
+        lines.append(f'{m}_count{{rank="{rank}"}} {h["count"]}')
+        lines.append(f'{m}_sum{{rank="{rank}"}} {h["mean"] * h["count"]:g}')
+    return "\n".join(lines) + "\n"
+
+
+class Reporter:
+    """Daemon thread appending one ``metrics_summary()`` JSONL line to
+    ``path`` every ``interval`` seconds (plus a final line at stop) —
+    the flight recorder for runs without a scrape endpoint."""
+
+    def __init__(self, path, interval=10.0, registry=None):
+        self._path = path
+        self._interval = float(interval)
+        self._registry = registry or _metrics
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="mxnet_tpu-metrics-reporter")
+        self._thread.start()
+
+    def _write_line(self):
+        line = {"t": time.time(), "rank": process_rank()}
+        line.update(self._registry.summary())
+        with open(self._path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._write_line()
+            except Exception:  # noqa: BLE001 — a transient fs error or
+                pass  # unserializable sample must not kill the recorder
+
+    def stop(self):
+        """Stop the thread and flush one final summary line."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        try:
+            self._write_line()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def start_reporter(path, interval=10.0, registry=None) -> Reporter:
+    """Start a periodic JSONL metrics reporter; returns the handle
+    (call ``.stop()`` to flush and join)."""
+    return Reporter(path, interval=interval, registry=registry)
 
 
 # -- XLA-level tracing (the per-kernel story) ---------------------------
@@ -233,5 +480,18 @@ def stop_xla_trace():
 
 
 # env autostart (reference: MXNET_PROFILER_AUTOSTART, env_var.md:63-72)
-if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+def _env_autostart(environ=None) -> bool:
+    """Start the profiler when MXNET_PROFILER_AUTOSTART=1 — unless
+    MXNET_PROFILER_NO_AUTOSTART=1 opts out (test suites and embedding
+    apps must be able to import the package without a module import
+    flipping global profiler state).  Returns whether it started."""
+    env = os.environ if environ is None else environ
+    if env.get("MXNET_PROFILER_AUTOSTART", "0") != "1":
+        return False
+    if env.get("MXNET_PROFILER_NO_AUTOSTART", "0") == "1":
+        return False
     profiler_set_state("run")
+    return True
+
+
+_env_autostart()

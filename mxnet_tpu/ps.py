@@ -35,17 +35,20 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import logging
 import os
 import pickle
 import socket
 import socketserver
 import struct
 import threading
+import time
 import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import profiler as _prof
 from .base import MXNetError
 
 __all__ = ["ParameterServer", "PSClient", "ShardedPSClient",
@@ -192,17 +195,35 @@ class ParameterServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  secret: bytes = b"", num_workers: int = 1,
-                 sync: bool = False):
+                 sync: bool = False, watchdog_deadline: Optional[float] = None):
         self._store: Dict[Any, np.ndarray] = {}
         self._applied: Dict[Any, int] = {}   # pushes applied (version)
         self._round: Dict[Any, int] = {}     # completed update rounds
         self._pending: Dict[Any, np.ndarray] = {}
         self._contrib: Dict[Any, set] = {}   # workers in the open round
+        # straggler telemetry: per-key {worker: arrival wall time} for
+        # the OPEN round, plus when the round opened and whether the
+        # watchdog already named the stragglers for it
+        self._arrivals: Dict[Any, Dict[int, float]] = {}
+        self._round_open_t: Dict[Any, float] = {}
+        self._round_warned: Dict[Any, bool] = {}
         self._updater = None
         self._secret = secret
         self._num_workers = num_workers
         self._sync = sync
         self._cond = threading.Condition()
+        from .base import get_env
+
+        self._watchdog_deadline = (
+            get_env("MXNET_WATCHDOG_DEADLINE", 60.0, float)
+            if watchdog_deadline is None else float(watchdog_deadline))
+        self._closing = threading.Event()
+        self._watchdog = None
+        if sync and self._watchdog_deadline > 0:
+            self._watchdog = threading.Thread(
+                target=self._watch_rounds, daemon=True,
+                name="mxnet_tpu-ps-watchdog")
+            self._watchdog.start()
         server_self = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -269,6 +290,13 @@ class ParameterServer:
                                 f"{worker} timed out waiting for round "
                                 f"completion (a peer never pushed?)")
                         self._contrib.setdefault(key, set()).add(worker)
+                        # straggler telemetry: when each worker's push
+                        # for the open round landed
+                        now = time.time()
+                        arrivals = self._arrivals.setdefault(key, {})
+                        if not arrivals:
+                            self._round_open_t[key] = now
+                        arrivals[worker] = now
                         if key in self._pending:
                             self._pending[key] = self._pending[key] + grad
                         else:
@@ -276,6 +304,14 @@ class ParameterServer:
                                 grad, dtype=np.float64
                                 if grad.dtype == np.float64 else np.float32)
                         if len(self._contrib[key]) >= self._num_workers:
+                            arrivals = self._arrivals.pop(key, {})
+                            self._round_open_t.pop(key, None)
+                            self._round_warned.pop(key, None)
+                            if len(arrivals) > 1:
+                                _prof.observe(
+                                    "ps.round_spread_ms",
+                                    (max(arrivals.values())
+                                     - min(arrivals.values())) * 1e3)
                             del self._contrib[key]  # open the next round
                             self._apply(key, self._pending.pop(key))
                 return b"\x00"
@@ -328,6 +364,7 @@ class ParameterServer:
                         self._updater = opt.get_updater(pickle.loads(blob))
                 return b"\x00"
             if op == _STOP:
+                self._closing.set()
                 threading.Thread(target=self._server.shutdown,
                                  daemon=True).start()
                 return b"\x00"
@@ -336,6 +373,41 @@ class ParameterServer:
             # must travel back to the worker as an error frame; letting
             # it escape would kill the handler thread silently
             return _err_body(f"{type(e).__name__}: {e}")
+
+    def _watch_rounds(self):
+        """Straggler watchdog: scan open sync rounds; once a round has
+        been open longer than the deadline, log which workers' pushes
+        arrived and which are still missing — the hung-job question a
+        silent 600 s wait_for timeout never answers."""
+        poll = max(0.05, min(1.0, self._watchdog_deadline / 4))
+        while not self._closing.wait(poll):
+            now = time.time()
+            reports = []
+            with self._cond:
+                for k, t_open in self._round_open_t.items():
+                    if self._round_warned.get(k):
+                        continue
+                    if now - t_open > self._watchdog_deadline:
+                        self._round_warned[k] = True
+                        reports.append(
+                            (k, now - t_open,
+                             sorted(self._arrivals.get(k, {}))))
+            for k, age, arrived in reports:
+                # worker ids are ranks when the client passed worker=rank
+                # (DistKVStore does); auto-assigned ids can't be mapped
+                # back to the launch-time rank set, so name only arrivals
+                if all(isinstance(w, int) and 0 <= w < self._num_workers
+                       for w in arrived):
+                    missing: Any = sorted(
+                        set(range(self._num_workers)) - set(arrived))
+                else:
+                    missing = f"{self._num_workers - len(arrived)} unknown"
+                logging.warning(
+                    "[watchdog] ps sync round for key %r open %.1fs "
+                    "(deadline %.1fs): arrived workers %s, waiting on "
+                    "workers %s", k, age, self._watchdog_deadline,
+                    arrived, missing)
+                _prof.inc_counter("watchdog.ps_round_timeouts")
 
     def _apply(self, key, grad: np.ndarray) -> None:
         """Run the updater (or plain assign) — caller holds the lock."""
@@ -357,8 +429,11 @@ class ParameterServer:
         self._cond.notify_all()
 
     def close(self):
+        self._closing.set()
         self._server.shutdown()
         self._server.server_close()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
 
 
 # ---------------------------------------------------------------------------
@@ -430,13 +505,23 @@ class PSClient:
         self._call(_body_init(key, value))
 
     def push(self, key, grad: np.ndarray):
-        self._call(_body_push(key, grad, sync=False, worker=self._worker))
+        grad = np.asarray(grad)
+        with _prof.scope("ps.push", "comm",
+                         args={"key": str(key), "bytes": int(grad.nbytes)}):
+            self._call(_body_push(key, grad, sync=False,
+                                  worker=self._worker))
 
     def push_sync(self, key, grad: np.ndarray):
-        self._call(_body_push(key, grad, sync=True, worker=self._worker))
+        grad = np.asarray(grad)
+        with _prof.scope("ps.push_sync", "comm",
+                         args={"key": str(key), "bytes": int(grad.nbytes)}):
+            self._call(_body_push(key, grad, sync=True,
+                                  worker=self._worker))
 
     def pull(self, key, min_round: int = 0) -> np.ndarray:
-        resp = self._call(_body_pull(key, min_round))
+        with _prof.scope("ps.pull", "comm",
+                         args={"key": str(key), "min_round": min_round}):
+            resp = self._call(_body_pull(key, min_round))
         arr, _ = _unpack_tensor(resp, 1 + 8)
         return np.array(arr)  # own the buffer (resp view dies here)
 
@@ -549,10 +634,14 @@ class ShardedPSClient:
     def _push(self, key, grad: np.ndarray, sync: bool):
         grad = np.asarray(grad)
         flat = grad.reshape(-1)
-        self._fan_out([
-            (cl, _body_push(wk, flat[a:b] if (a, b) != (0, grad.size)
-                            else grad, sync, worker=cl._worker), None)
-            for cl, wk, a, b in self._plan(key, grad.size)])
+        plan = self._plan(key, grad.size)
+        with _prof.scope("ps.push_sync" if sync else "ps.push", "comm",
+                         args={"key": str(key), "bytes": int(grad.nbytes),
+                               "shards": len(plan)}):
+            self._fan_out([
+                (cl, _body_push(wk, flat[a:b] if (a, b) != (0, grad.size)
+                                else grad, sync, worker=cl._worker), None)
+                for cl, wk, a, b in plan])
 
     def push(self, key, grad: np.ndarray):
         self._push(key, grad, sync=False)
@@ -569,11 +658,15 @@ class ShardedPSClient:
         if shape is None:
             raise MXNetError("pull of a split key needs the shape")
         out = np.empty(size, dtype=np.dtype(dtype) if dtype else np.float32)
-        for resp, (a, b) in self._fan_out([
-                (cl, _body_pull(wk, min_round), (a, b))
-                for cl, wk, a, b in plan]):
-            arr, _ = _unpack_tensor(resp, 1 + 8)
-            out[a:b] = arr.reshape(-1)
+        with _prof.scope("ps.pull", "comm",
+                         args={"key": str(key), "bytes": int(out.nbytes),
+                               "shards": len(plan),
+                               "min_round": min_round}):
+            for resp, (a, b) in self._fan_out([
+                    (cl, _body_pull(wk, min_round), (a, b))
+                    for cl, wk, a, b in plan]):
+                arr, _ = _unpack_tensor(resp, 1 + 8)
+                out[a:b] = arr.reshape(-1)
         return out.reshape(shape)
 
     def set_optimizer(self, optimizer):

@@ -293,7 +293,9 @@ class InferenceEngine:
                 f"{self._max_batch}; split it client-side")
         fut: Future = Future()
         req = _Request(batch, n, fut, time.perf_counter())
-        profiler.observe("serving.queue_depth", self._queue.qsize())
+        # gauge only — exporting the same family as both a histogram
+        # and a gauge would make prometheus_text() an invalid exposition
+        profiler.set_gauge("serving.queue_depth", self._queue.qsize())
         # backpressure without holding the accept lock through a
         # blocking put: a full queue must stall THIS caller only, not
         # serialize every other submitter (or close()) behind it
@@ -341,25 +343,32 @@ class InferenceEngine:
                 self._bucket_ms[b] = (time.perf_counter() - t0) * 1e3
 
     # -- stats ----------------------------------------------------------
-    _COUNTERS = ("requests", "images", "batches", "flush_full",
+    _COUNTERS = ("requests", "images", "slots", "batches", "flush_full",
                  "flush_timeout", "flush_boundary", "cache_hits",
                  "cache_misses")
 
     def stats(self) -> dict:
         """Engine-local snapshot: counters, per-bucket compile counts,
-        mean batch-fill ratio, latency percentiles."""
+        slot-weighted batch-fill ratio, latency percentiles."""
         with self._lock:
             compiles = dict(self.compiles)
         summ = self._metrics.summary()
-        hists = summ["histograms"]
-        fill = hists.get("fill")
-        lat = hists.get("latency_ms")
+        lat = summ["histograms"].get("latency_ms")
         out = {name: int(summ["counters"].get(name, 0))
                for name in self._COUNTERS}
         out["compiles"] = compiles
-        out["batch_fill_ratio"] = fill["mean"] if fill else None
+        # slot-weighted: real samples / padded slots dispatched — the
+        # documented padding-waste metric (an unweighted mean of
+        # per-batch fills would overstate utilization whenever bucket
+        # sizes are mixed)
+        out["batch_fill_ratio"] = (out["images"] / out["slots"]
+                                   if out["slots"] else None)
         out["p50_ms"] = lat["p50"] if lat else None
+        out["p90_ms"] = lat["p90"] if lat else None
         out["p99_ms"] = lat["p99"] if lat else None
+        # rate-since-reset (engine start), from the shared summary schema
+        out["requests_per_s"] = summ["rates"].get("requests", 0.0)
+        out["images_per_s"] = summ["rates"].get("images", 0.0)
         out["buckets"] = list(self._buckets)
         return out
 
@@ -430,7 +439,8 @@ class InferenceEngine:
             if exe is not None:
                 self._count("cache_hits")
                 return exe
-            with profiler.scope(f"serving.compile.b{bucket}", "serving"):
+            with profiler.scope(f"serving.compile.b{bucket}", "serving",
+                                args={"bucket": bucket}):
                 exe = self._model.compile(bucket, self._donate)
             self._cache[bucket] = exe
             with self._lock:
@@ -529,7 +539,8 @@ class InferenceEngine:
             compiled_now = bucket not in self._cache
             exe = self._executable(bucket)
             names = self._model.input_names
-            with profiler.scope(f"serving.stage.b{bucket}", "serving"):
+            with profiler.scope(f"serving.stage.b{bucket}", "serving",
+                                args={"bucket": bucket, "n": total}):
                 padded = {}
                 for name in names:
                     buf = np.zeros(
@@ -542,7 +553,9 @@ class InferenceEngine:
                     # async H2D: the PrefetchingIter staging machinery —
                     # this transfer overlaps the previous batch's compute
                     padded[name] = stage_array(buf, self._model.device)
-            with profiler.scope(f"serving.enqueue.b{bucket}", "serving"):
+            with profiler.scope(f"serving.enqueue.b{bucket}", "serving",
+                                args={"bucket": bucket, "n": total,
+                                      "reason": reason}):
                 outs = exe(padded)  # async dispatch; completion thread blocks
         except Exception as exc:
             for req in batch:
@@ -554,9 +567,12 @@ class InferenceEngine:
             self._inflight_n += 1
         self._count("batches")
         self._count("images", total)
+        self._count("slots", bucket)  # padded capacity actually dispatched
         self._count(f"flush_{reason}")
-        self._metrics.observe("fill", total / bucket)
         profiler.observe("serving.batch_fill", total / bucket)
+        # re-sample post-drain so the gauge doesn't freeze at the
+        # backlog the LAST submit happened to see
+        profiler.set_gauge("serving.queue_depth", self._queue.qsize())
         self._inflight.put((outs, batch, t0, bucket, compiled_now))
 
     # -- completion thread: block on device, slice, resolve -------------
@@ -581,7 +597,9 @@ class InferenceEngine:
             # dispatch→completion wall: the per-bucket cost span (the
             # enqueue-side scope only times XLA's async handoff)
             profiler.add_event(f"serving.batch.b{bucket}", t0, now - t0,
-                               "serving")
+                               "serving",
+                               args={"bucket": bucket,
+                                     "n": sum(r.n for r in batch)})
             # cost-model sample: occupancy, not latency — a pipelined
             # batch dispatched while its predecessor still computed
             # only occupied the device from the predecessor's finish.

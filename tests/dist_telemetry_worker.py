@@ -1,0 +1,52 @@
+"""Worker for the 2-process telemetry proof (test_dist.py::
+test_telemetry_traces_and_watchdog):
+
+* both ranks run dist_sync kvstore traffic with the profiler on and
+  dump a per-rank Chrome trace into the shared dir (argv[1]) for
+  ``tools/trace_merge.py``;
+* rank 1 deliberately sleeps past MXNET_WATCHDOG_DEADLINE before the
+  barrier, so rank 0's straggler watchdog must NAME rank 1 in its log
+  while the barrier is still open.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+SHAPE = (4, 5)
+
+
+def main():
+    trace_dir = sys.argv[1]
+    mx.profiler.profiler_set_config(mode="all", filename="")
+    mx.profiler.profiler_set_state("run")
+
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.push(3, mx.nd.ones(SHAPE) * (rank + 1))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    expected = sum(r + 1 for r in range(nw))
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, float(expected)))
+
+    # the deliberate straggler: rank 1 arrives late at the barrier, so
+    # rank 0's watchdog (deadline < this sleep) fires and names it
+    if rank == 1:
+        time.sleep(float(os.environ.get("STRAGGLER_SLEEP_S", "3")))
+    kv.barrier()
+
+    path = mx.profiler.dump_rank_trace(trace_dir)
+    assert os.path.isfile(path), path
+    print(f"worker {rank}/{nw}: telemetry OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -211,6 +211,57 @@ def test_launch_module_fit_dist_sync_on_server(tmp_path):
                                    err_msg=f"server-sync != single for {k}")
 
 
+def test_telemetry_traces_and_watchdog(tmp_path):
+    """The observability acceptance path: 2 real processes trace their
+    kvstore traffic, dump per-rank Chrome traces, tools/trace_merge.py
+    merges them into ONE valid timeline with both pids — and a
+    deliberately delayed worker is NAMED by the barrier watchdog log
+    within the deadline (instead of the job hanging silently)."""
+    import json
+
+    trace_dir = str(tmp_path / "traces")
+    env = _worker_env()
+    env["MXNET_WATCHDOG_DEADLINE"] = "1"
+    env["STRAGGLER_SLEEP_S"] = "4"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--cpu",
+         sys.executable,
+         os.path.join(REPO, "tests", "dist_telemetry_worker.py"), trace_dir],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out
+    assert "worker 0/2: telemetry OK" in out
+    assert "worker 1/2: telemetry OK" in out
+
+    # the watchdog named the straggler while rank 1 was still sleeping
+    assert "[watchdog] kvstore barrier" in out, out
+    assert "waiting on ranks [1]" in out, out
+
+    # per-rank traces exist and merge into one valid Chrome trace
+    for rank in (0, 1):
+        assert os.path.isfile(
+            os.path.join(trace_dir, f"trace_rank{rank}.json"))
+    merged = str(tmp_path / "merged.json")
+    rm = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_merge.py"),
+         trace_dir, "-o", merged],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert rm.returncode == 0, rm.stdout + rm.stderr
+    with open(merged) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    pids = {e["pid"] for e in evs}
+    assert pids == {0, 1}, pids  # both ranks present, rank-keyed pids
+    names = {e["name"] for e in evs if e.get("ph") == "X"}
+    assert any("kvstore" in n for n in names), names
+    for pid in (0, 1):  # both ranks contributed real span events
+        assert any(e.get("ph") == "X" and e["pid"] == pid for e in evs)
+    # spans carry args (bytes moved) for the trace viewer detail pane
+    assert any(e.get("args", {}).get("bytes")
+               for e in evs if e.get("ph") == "X"), names
+
+
 def test_launch_two_process_dist_async():
     """Real async consistency: unequal push rates, pulls without
     rendezvous, every push applied on arrival (reference:

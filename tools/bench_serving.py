@@ -129,6 +129,7 @@ def bench_naive(pred, mk_sample, n_requests):
     wall = time.perf_counter() - t0
     return {"img_s": n_requests / wall,
             "p50_ms": float(np.percentile(lat, 50)),
+            "p90_ms": float(np.percentile(lat, 90)),
             "p99_ms": float(np.percentile(lat, 99))}
 
 
@@ -168,10 +169,14 @@ def bench_point(eng, mk_sample, clients, per_client):
     st1 = eng.stats()
     total = clients * per_client
     batches = st1["batches"] - st0["batches"]
+    # p50/p90/p99 here deliberately match MetricsRegistry.summary()'s
+    # histogram schema (and eng.stats()), so the bench, the JSONL
+    # reporter and the Prometheus exporter all speak one vocabulary
     return {
         "clients": clients,
         "throughput_img_s": round(total / wall, 2),
         "p50_ms": round(float(np.percentile(lats, 50)), 3),
+        "p90_ms": round(float(np.percentile(lats, 90)), 3),
         "p99_ms": round(float(np.percentile(lats, 99)), 3),
         "avg_batch": round(total / max(batches, 1), 2),
         "batches": batches,
@@ -252,6 +257,7 @@ def main():
         "clients": head["best"]["clients"],
         "throughput_img_s": head["best"]["throughput_img_s"],
         "p50_ms": head["best"]["p50_ms"],
+        "p90_ms": head["best"]["p90_ms"],
         "p99_ms": head["best"]["p99_ms"],
         "batch_fill_ratio": head["batch_fill_ratio"],
         "naive_img_s": head["naive_img_s"],

@@ -12,17 +12,23 @@ import argparse
 import re
 
 
+# metric values may be plain decimals, scientific notation (a cosine
+# lr schedule logs 1.5e-05), or nan/inf (a diverged run) — the old
+# ([.\d]+) pattern silently skipped those lines
+_NUM = r"([-+]?(?:[.\d]+(?:[eE][-+]?\d+)?|nan|NaN|NAN|inf|Inf|INF))"
+
+
 def parse(lines):
-    res = [re.compile(r".*Epoch\[(\d+)\] Train.*=([.\d]+)"),
-           re.compile(r".*Epoch\[(\d+)\] Valid.*=([.\d]+)"),
-           re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")]
+    res = [re.compile(r".*Epoch\[(\d+)\] Train.*=" + _NUM),
+           re.compile(r".*Epoch\[(\d+)\] Valid.*=" + _NUM),
+           re.compile(r".*Epoch\[(\d+)\] Time.*=" + _NUM)]
     data = {}
     for line in lines:
         for i, r in enumerate(res):
             m = r.match(line)
             if m is not None:
                 epoch = int(m.group(1))
-                val = float(m.group(2))
+                val = float(m.group(2))  # float() accepts nan/inf spellings
                 row = data.setdefault(epoch, [[0.0, 0] for _ in res])
                 row[i][0] += val
                 row[i][1] += 1

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Merge per-rank Chrome traces into one clock-aligned timeline.
+
+Every distributed worker dumps its own trace
+(``mx.profiler.dump_rank_trace(dir)`` → ``trace_rank<N>.json``); each
+file carries a ``metadata.clock_sync`` anchor — the same instant read
+on ``time.time()`` (shared wall clock) and ``time.perf_counter()``
+(the clock the event timestamps are relative to).  This tool maps
+every trace onto the wall clock, rebases to the earliest trace, remaps
+pids so ranks stay distinct even across hosts that reuse OS pids, and
+writes one Chrome-trace JSON viewable in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing — the Dapper-style
+"where did this step go, on every worker" view.
+
+    python tools/trace_merge.py /tmp/traces/trace_rank*.json -o merged.json
+    python tools/trace_merge.py /tmp/traces -o merged.json   # a directory
+
+Alignment quality is whatever the hosts' wall clocks share (NTP —
+typically well under a millisecond inside one cluster); events within
+a rank keep their exact monotonic-clock spacing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        trace = json.load(f)
+    if "traceEvents" not in trace:
+        raise ValueError(f"{path}: not a Chrome trace (no traceEvents)")
+    return trace
+
+
+def merge_traces(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge loaded per-rank traces into one Chrome-trace dict.
+
+    Traces without clock_sync metadata (plain Chrome traces) merge at
+    offset 0 — useful for eyeballing, meaningless for cross-rank
+    ordering."""
+    if not traces:
+        raise ValueError("no traces to merge")
+    # None = no clock_sync anchor (a plain Chrome trace): such a trace
+    # merges at offset 0 and must NOT drag the base to the epoch,
+    # which would shift every anchored trace by ~55 years
+    anchors: List[Any] = []
+    for t in traces:
+        sync = t.get("metadata", {}).get("clock_sync", {})
+        anchors.append(float(sync["wall_time_s"])
+                       if "wall_time_s" in sync else None)
+    anchored = [a for a in anchors if a is not None]
+    base = min(anchored) if anchored else 0.0
+
+    out_events: List[Dict[str, Any]] = []
+    ranks = []
+    used_pids: set = set()
+    for idx, (t, wall0) in enumerate(zip(traces, anchors)):
+        meta = t.get("metadata", {})
+        rank = meta.get("rank", idx)
+        ranks.append(rank)
+        # one pid per input trace, keyed by rank: os pids can collide
+        # across hosts, and the viewer groups rows by pid.  Two inputs
+        # claiming the same rank (traces from different runs, or dumps
+        # made without the launcher env) must still get distinct rows.
+        new_pid = rank
+        while new_pid in used_pids:
+            new_pid += 1000 * (idx + 1)
+        used_pids.add(new_pid)
+        offset_us = (wall0 - base) * 1e6 if wall0 is not None else 0.0
+        label = (f"rank {rank}" if new_pid == rank
+                 else f"rank {rank} (input {idx})")
+        seen_meta = False
+        for ev in t["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = new_pid
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    ev["args"] = {"name": label}
+                    seen_meta = True
+            elif "ts" in ev:
+                ev["ts"] = ev["ts"] + offset_us
+            out_events.append(ev)
+        if not seen_meta:
+            out_events.append({"name": "process_name", "ph": "M",
+                               "pid": new_pid, "tid": 0,
+                               "args": {"name": label}})
+    return {
+        "traceEvents": out_events,
+        "displayTimeUnit": "ms",
+        "metadata": {"merged_ranks": ranks, "wall_base_s": base},
+    }
+
+
+def collect_inputs(paths: List[str]) -> List[str]:
+    """Expand directories to their trace_rank*.json files."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(glob.glob(os.path.join(p, "trace_rank*.json")))
+            if not found:
+                raise SystemExit(f"{p}: no trace_rank*.json files")
+            files.extend(found)
+        else:
+            files.append(p)
+    if len(files) < 1:
+        raise SystemExit("no input traces")
+    return files
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("inputs", nargs="+",
+                        help="per-rank trace files, or a directory of "
+                             "trace_rank*.json")
+    parser.add_argument("-o", "--output", default="merged_trace.json")
+    args = parser.parse_args(argv)
+    files = collect_inputs(args.inputs)
+    merged = merge_traces([load_trace(f) for f in files])
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    n_ev = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
+    print(f"merged {len(files)} trace(s), ranks {merged['metadata']['merged_ranks']}, "
+          f"{n_ev} events -> {args.output}", file=sys.stderr)
+    print(args.output)
+
+
+if __name__ == "__main__":
+    main()
