@@ -39,6 +39,7 @@ from . import callback
 from . import io
 from . import recordio
 from . import image
+from . import comm
 from . import kvstore
 from . import kvstore as kv
 from . import model

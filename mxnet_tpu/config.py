@@ -121,6 +121,38 @@ register_env(
     "across ALL parameter-server shards instead of living whole on "
     "one hashed shard (reference: comm.h:65, kvstore_dist.h:286-296).")
 register_env(
+    "MXNET_KVSTORE_BUCKET_BYTES", 4 << 20, int,
+    "Gradient-comm bucket capacity in BYTES (default 4 MiB): dist-"
+    "kvstore pushes coalesce same-dtype gradients into flat buckets "
+    "this large, so one collective / one wire frame moves many keys.  "
+    "A single gradient larger than the bound rides its own bucket.  "
+    "The pack layout is deterministic (submission order), so bucketing "
+    "never changes the numerics — see mxnet_tpu/comm.py.")
+register_env(
+    "MXNET_KVSTORE_GRAD_DTYPE", "fp32", str,
+    "Wire dtype for float32 gradient payloads on the dist kvstore: "
+    "'fp32' (default, lossless), 'bf16' or 'fp16' halve the bytes on "
+    "the wire; accumulation stays float32 on the receiving side.  "
+    "bf16 keeps fp32's exponent range (safe for raw gradient "
+    "magnitudes); fp16 has more mantissa but overflows past 65504 — "
+    "prefer bf16 unless gradients are pre-scaled.  Latched per bucket "
+    "at seal time on the pushing thread, so a runtime flip lands on "
+    "the same bucket boundary on every rank (flip at the same point "
+    "in the push sequence everywhere).")
+register_env(
+    "MXNET_KVSTORE_OVERLAP", 1, int,
+    "1 (default): dist-kvstore pushes enqueue into the async bucketed "
+    "comm scheduler (background thread, priority-ordered, overlaps "
+    "the rest of the step; pulls wait only at the true dependency "
+    "point).  0: the pre-scheduler blocking per-key push/pull path "
+    "(debugging / apples-to-apples benchmarking).")
+register_env(
+    "MXNET_KVSTORE_INFLIGHT", 4, int,
+    "Max gradient buckets in flight per parameter-server connection "
+    "(the windowed send-now/collect-later pipeline); also bounds the "
+    "comm scheduler's finisher queue.  1 = fully serialized "
+    "round-trips.")
+register_env(
     "MXNET_KVSTORE_SYNC_ON_SERVER", 0, int,
     "dist_sync architecture switch: 1 runs the optimizer ON the "
     "sharded parameter servers after NumWorkers pushes (workers "

@@ -32,12 +32,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import comm as _comm
 from . import profiler as _prof
 from .base import MXNetError
 from .ndarray import NDArray
 from . import optimizer as opt
 
 __all__ = ["KVStore", "create"]
+
+
+def _fill_outs(cur, olist):
+    """ONE host→device conversion per pulled key, reused by every out
+    array (astype is a no-op view for matching dtypes)."""
+    dev = jnp.asarray(cur)
+    for o in olist:
+        o._set_data(dev.astype(o.dtype))
 
 
 @jax.jit
@@ -56,6 +65,7 @@ class KVStore:
         self._store: Dict[Any, NDArray] = {}
         self._updater: Optional[opt.Updater] = None
         self._optimizer: Optional[opt.Optimizer] = None
+        self._rescale = 1.0
 
     # ------------------------------------------------------------------
     def init(self, key, value):
@@ -75,6 +85,8 @@ class KVStore:
                 raise MXNetError(f"push to uninitialized key {k}")
             merged = vlist[0]._data if len(vlist) == 1 else _tree_sum(
                 tuple(v._data for v in vlist))
+            if self._rescale != 1.0:
+                merged = merged * self._rescale
             stored = self._store[k]
             if self._updater is not None:
                 self._updater(k, NDArray(merged), stored)
@@ -104,8 +116,14 @@ class KVStore:
     def _set_updater(self, updater):
         self._updater = updater
 
-    def set_rescale(self, rescale):  # convenience no-op hook
-        pass
+    def set_rescale(self, rescale):
+        """Scale factor applied ONCE to every pushed gradient, after
+        the local merge and before any bucketing/compression/
+        aggregation (reference: KVStore gradient rescaling).  Distinct
+        from the optimizer's ``rescale_grad`` (which runs inside the
+        updater): this rescales what travels over the wire, so e.g. a
+        1/num_workers here keeps bf16-compressed payloads in range."""
+        self._rescale = float(rescale)
 
     # ------------------------------------------------------------------
     @property
@@ -127,9 +145,13 @@ class KVStore:
 
             multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")
 
-    def get_num_dead_node(self, node_id=0, timeout=0):
-        """reference: kvstore.h:242 — JAX runtime handles liveness; a
-        missing peer fails collectives, so report 0 while healthy."""
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Count peers considered dead.  ``timeout`` is the heartbeat-
+        staleness threshold in SECONDS (same default and meaning as
+        DistKVStore, which actually reads heartbeat files).  Here the
+        JAX runtime handles liveness — a missing peer fails
+        collectives — so report 0 while healthy (reference:
+        kvstore.h:242)."""
         return 0
 
     def send_command_to_servers(self, head, body):
@@ -248,6 +270,14 @@ class DistKVStore(TPUKVStore):
     with the psum inside the jitted step — use ``kvstore='tpu'`` under
     the launcher (see TPUKVStore).  Barrier = a tiny all-device
     collective rendezvous.
+
+    Gradient traffic rides the async bucketed comm scheduler
+    (mxnet_tpu.comm; MXNET_KVSTORE_OVERLAP=0 disables): push()
+    enqueues, a background thread moves sealed buckets (one collective
+    / one multi-key wire frame for many keys, optional bf16/fp16 wire
+    dtype), pull() waits only for its key, and pull_async()/
+    drain_pulls() defer the weight reads to the Module's next
+    parameter use — see README "Gradient communication".
     """
 
     def __init__(self, kv_type="dist_sync"):
@@ -265,10 +295,26 @@ class DistKVStore(TPUKVStore):
         self._sync_round: Dict[Any, int] = {}
         self._key_meta: Dict[Any, tuple] = {}  # key → (shape, dtype)
         self._needs_init_barrier = False
+        self._comm: Optional[_comm.CommScheduler] = None
+        self._ps_launch = None  # built lazily from comm.make_ps_launch
+        self._pending_pulls: List[tuple] = []
         super().__init__(kv_type)  # TPUKVStore wires the dist runtime
         self._start_heartbeat()
         if self._async or self._server_sync:
             self._start_parameter_server()
+        # the gradient comm scheduler: pushes coalesce into buckets
+        # consumed by a background thread, so the allgather / PS round-
+        # trip (and its D2H staging) overlaps the rest of the step.
+        # MXNET_KVSTORE_OVERLAP=0 restores the blocking per-key path.
+        if jax.process_count() > 1 and _comm.overlap_enabled():
+            # a COLLECTIVE transport must launch buckets in submission
+            # order — every rank's comm thread has to issue the same
+            # collective sequence, and a priority pop whose heap
+            # contents differ by thread timing would cross-sum ranks.
+            # The point-to-point PS transport honors priority for real.
+            self._comm = _comm.CommScheduler(
+                self._comm_launch, strict_order=(self._ps is None),
+                name=f"mxnet_tpu-kvstore-comm-r{self.rank}")
 
     # -- parameter servers (reference: kvstore_dist_server.h) ----------
     def _start_parameter_server(self):
@@ -335,6 +381,9 @@ class DistKVStore(TPUKVStore):
         self._ps = ShardedPSClient(addrs, secret=secret, worker=self.rank)
 
     def init(self, key, value):
+        # a mid-training init must not race in-flight pushes (and the
+        # sync path's broadcast below is a main-thread collective)
+        self._sync_comm()
         if self._ps is not None:
             # only rank 0 pushes the initial weights, then everyone
             # rendezvous (reference: kvstore_dist.h Init — rank 0 sends,
@@ -447,8 +496,25 @@ class DistKVStore(TPUKVStore):
             for k, vlist in zip(keys, values):
                 merged = vlist[0]._data if len(vlist) == 1 else _tree_sum(
                     tuple(v._data for v in vlist))
-                # the D2H materialization is part of the push cost the
-                # span exists to measure — keep it inside the scope
+                if self._rescale != 1.0:
+                    merged = merged * self._rescale
+                if self._server_sync:
+                    self._sync_round[k] = self._sync_round.get(k, 0) + 1
+                if self._comm is not None:
+                    # enqueue-only: bucketing, D2H staging and the wire
+                    # round-trip all happen on the comm thread
+                    with _prof.scope("kvstore.push", "comm",
+                                     args={"key": str(k),
+                                           "bytes": int(getattr(
+                                               merged, "nbytes", 0)),
+                                           "priority": priority,
+                                           "async": True,
+                                           "sync": self._server_sync}):
+                        self._comm.submit(k, merged, priority)
+                    continue
+                # blocking path (MXNET_KVSTORE_OVERLAP=0): the D2H
+                # materialization is part of the push cost the span
+                # exists to measure — keep it inside the scope
                 with _prof.scope("kvstore.push", "comm",
                                  args={"key": str(k),
                                        "bytes": int(getattr(merged,
@@ -456,7 +522,6 @@ class DistKVStore(TPUKVStore):
                                        "sync": self._server_sync}):
                     host = np.asarray(merged)
                     if self._server_sync:
-                        self._sync_round[k] = self._sync_round.get(k, 0) + 1
                         self._ps.push_sync(k, host)
                     else:
                         self._ps.push(k, host)
@@ -471,6 +536,17 @@ class DistKVStore(TPUKVStore):
                 raise MXNetError(f"push to uninitialized key {k}")
             merged = vlist[0]._data if len(vlist) == 1 else _tree_sum(
                 tuple(v._data for v in vlist))
+            if self._rescale != 1.0:
+                merged = merged * self._rescale
+            if self._comm is not None:
+                with _prof.scope("kvstore.push", "comm",
+                                 args={"key": str(k),
+                                       "bytes": int(getattr(
+                                           merged, "nbytes", 0)),
+                                       "priority": priority,
+                                       "async": True}):
+                    self._comm.submit(k, merged, priority)
+                continue
             with _prof.scope("kvstore.push.allreduce", "comm",
                              args={"key": str(k),
                                    "bytes": int(getattr(merged, "nbytes",
@@ -482,6 +558,56 @@ class DistKVStore(TPUKVStore):
                 self._updater(k, NDArray(merged), stored)
             else:
                 stored._set_data(merged.astype(stored.dtype))
+
+    # -- comm-scheduler transport launches (run on the comm thread) ----
+    def _comm_launch(self, bucket):
+        """Transport one sealed bucket; see CommScheduler."""
+        if self._ps is not None:
+            if self._ps_launch is None:
+                self._ps_launch = _comm.make_ps_launch(
+                    self._ps, sync=self._server_sync)
+            return self._ps_launch(bucket)
+        return self._launch_allgather_bucket(bucket)
+
+    def close(self):
+        """Land any deferred pulls, then drain and stop the gradient
+        comm scheduler (further pushes fall back to the blocking
+        path).  The PS server/client daemon threads keep their
+        process-lifetime lifecycle."""
+        if self._comm is not None:
+            self._sync_comm()  # deferred pulls must land, not vanish
+            self._comm.close()
+            self._comm = None
+
+    def _launch_allgather_bucket(self, bucket):
+        """dist_sync replicated-updater transport: ONE allgather moves
+        the whole bucket, every rank computes the identical global sum
+        and runs the replicated updater per key.  The flat elementwise
+        sum is bitwise-identical to the per-key sums the blocking path
+        computed (same adds, same order), so bucketing changes the
+        transport, never the numerics."""
+        from jax.experimental import multihost_utils
+
+        flat = _comm.pack_bucket(bucket.arrays)
+        wdt = bucket.wire  # latched at seal — identical on every rank
+        compress = wdt is not None and flat.dtype == jnp.float32
+        wire = flat.astype(jnp.dtype(wdt)) if compress else flat
+        _prof.inc_counter("kvstore.wire_bytes",
+                          float(getattr(wire, "nbytes", 0)))
+        gathered = jnp.asarray(multihost_utils.process_allgather(wire))
+        if compress:
+            # fp32 accumulation of the compressed wire payloads
+            gathered = gathered.astype(jnp.float32)
+        summed = jnp.sum(gathered, axis=0)
+        for e, g in zip(bucket.entries,
+                        _comm.unpack_bucket(summed, bucket.entries)):
+            stored = self._store[e.key]
+            if self._updater is not None:
+                self._updater(e.key, NDArray(g), stored)
+            else:
+                stored._set_data(g.astype(stored.dtype))
+        return None
+
 
     def _init_barrier(self):
         """One rendezvous before the first post-init pull/push: rank
@@ -495,6 +621,14 @@ class DistKVStore(TPUKVStore):
         if self._ps is not None:
             self._init_barrier()
             assert out is not None
+            if self._comm is not None:
+                # quiesce the WHOLE scheduler, not just these keys'
+                # buckets: a main-thread wire op may not take an
+                # in-flight window slot while the comm thread still
+                # holds undrained finishers on the same connections —
+                # comm blocked in _begin + main blocked behind comm's
+                # tickets would mutually stall until the 630s timeouts
+                self._comm.drain()
             keys, outs = _key_value_lists(key, out)
             for k, olist in zip(keys, outs):
                 shape, dtype = self._key_meta.get(k, (None, None))
@@ -507,10 +641,76 @@ class DistKVStore(TPUKVStore):
                         k, shape=shape, dtype=dtype,
                         min_round=self._sync_round.get(k, 0)
                         if self._server_sync else 0)
-                for o in olist:
-                    o._set_data(jnp.asarray(cur).astype(o.dtype))
+                _fill_outs(cur, olist)
             return
+        if self._comm is not None:
+            # allgather mode: the comm thread runs the updater into
+            # self._store as each bucket completes — wait per key, then
+            # the plain local copy below reads current weights
+            keys, _outs = _key_value_lists(key, out)
+            for k in keys:
+                self._comm.wait(k)
         super().pull(key, out=out, priority=priority)
+
+    def pull_async(self, key, out, priority=0):
+        """Deferred pull: registers the destination arrays and returns
+        immediately; the copy (and for the PS transport, the batched
+        wire pull) completes at :meth:`drain_pulls` — called by the
+        Module right before parameters are next consumed, the TRUE
+        dependency point.  Lets the push round-trips behind ``out``
+        overlap everything between update() and the next forward()."""
+        if self._comm is None:
+            return self.pull(key, out=out, priority=priority)
+        if self._ps is not None:
+            self._init_barrier()
+        assert out is not None
+        # seal partial buckets now so every registered pull has its
+        # push in flight before we return
+        self._comm.flush()
+        keys, outs = _key_value_lists(key, out)
+        for k, olist in zip(keys, outs):
+            self._pending_pulls.append(
+                (k, olist, self._sync_round.get(k, 0)
+                 if self._server_sync else 0))
+
+    def drain_pulls(self):
+        """Complete every deferred :meth:`pull_async`."""
+        if not self._pending_pulls:
+            return
+        pending, self._pending_pulls = self._pending_pulls, []
+        if self._comm is not None:  # close() lands pulls before nulling
+            if self._ps is not None:
+                # full quiesce before main-thread wire ops — see pull()
+                self._comm.drain()
+            else:
+                for k, _olist, _mr in pending:
+                    self._comm.wait(k)
+        if self._ps is not None:
+            specs = []
+            for k, _olist, mr in pending:
+                shape, dtype = self._key_meta.get(k, (None, None))
+                specs.append((k, shape, dtype, mr))
+            with _prof.scope("kvstore.pull", "comm",
+                             args={"keys": len(specs), "batched": True,
+                                   "sync": self._server_sync}):
+                arrs = self._ps.pull_multi(specs)
+            for (k, olist, _mr), cur in zip(pending, arrs):
+                _fill_outs(cur, olist)
+            return
+        for k, olist, _mr in pending:
+            src = self._store[k]
+            for o in olist:
+                o._set_data(src._data.astype(o.dtype))
+
+    def _sync_comm(self):
+        """Quiesce the comm scheduler + deferred pulls — required
+        before any main-thread collective (barrier, init broadcast):
+        two threads interleaving collectives across ranks in different
+        orders would deadlock or cross-sum."""
+        if self._comm is not None:
+            self._comm.drain()
+        if self._pending_pulls:
+            self.drain_pulls()
 
     # -- heartbeat-based failure detection -----------------------------
     def _start_heartbeat(self):
@@ -560,6 +760,9 @@ class DistKVStore(TPUKVStore):
 
         if jax.process_count() <= 1:
             return
+        # quiesce in-flight gradient comm first: the rendezvous
+        # collective must not interleave with comm-thread collectives
+        self._sync_comm()
         from jax.experimental import multihost_utils
 
         from .base import get_env
@@ -638,6 +841,12 @@ class DistKVStore(TPUKVStore):
             "arrived ranks %s, waiting on ranks %s",
             seq, deadline, self.rank, arrived, missing)
         _prof.inc_counter("watchdog.barrier_timeouts")
+
+    def save_optimizer_states(self, fname):
+        """Quiesce the comm thread (which may be mid-update) before
+        snapshotting the replicated updater's state."""
+        self._sync_comm()
+        super().save_optimizer_states(fname)
 
     def get_num_dead_node(self, node_id=0, timeout=60):
         """Count workers whose heartbeat file is stale (reference:

@@ -69,17 +69,39 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    """push grads, pull weights (reference: model.py:88-97)"""
+    """push grads, pull weights (reference: model.py:88-97).
+
+    Two phases, not per-key push-then-pull: EVERY key's push is issued
+    first (an async kvstore enqueues them into its comm scheduler and
+    returns immediately), then the pulls.  On a store exposing
+    ``pull_async`` the pulls are deferred all the way to the true
+    dependency point — the Module drains them right before parameters
+    are next consumed — so the gradient round-trips overlap the end of
+    the step, the metric update and the next batch's input pipeline
+    instead of serializing inside update()."""
+    live = []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list is None or (isinstance(grad_list, list) and grad_list[0] is None):
             continue
         kvstore.push(index, grad_list, priority=-index)
-        kvstore.pull(index, arg_list, priority=-index)
+        live.append((index, arg_list))
+    pull_async = getattr(kvstore, "pull_async", None)
+    for index, arg_list in live:
+        if pull_async is not None:
+            pull_async(index, arg_list, priority=-index)
+        else:
+            kvstore.pull(index, arg_list, priority=-index)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None):
-    """reference: model.py:99-115"""
+    """reference: model.py:99-115.
+
+    Pushes are issued for every key before the first (synchronous)
+    pull: the pulled values feed the local updater below, so this path
+    waits per key — but an async kvstore still overlaps key k's
+    round-trip with key k+1..N's pushes and earlier keys' updates."""
+    live = []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if not isinstance(arg_list, list):
@@ -88,6 +110,9 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None)
             continue
         if kvstore:
             kvstore.push(index, grad_list, priority=-index)
+        live.append((index, arg_list, grad_list))
+    for index, arg_list, grad_list in live:
+        if kvstore:
             kvstore.pull(index, grad_list, priority=-index)
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p

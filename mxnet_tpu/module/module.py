@@ -190,9 +190,19 @@ class Module(BaseModule):
                 zip(self._output_names, self._exec.outputs_cache)] \
             if self._exec.outputs_cache else self._inferred_output_shapes
 
+    def _drain_param_comm(self):
+        """Complete any deferred kvstore pulls before parameters are
+        consumed — the true dependency point the async gradient comm
+        scheduler defers to (update() registered the pulls; the comm
+        round-trips have been overlapping everything since)."""
+        kv = self._kvstore
+        if kv is not None and getattr(kv, "_pending_pulls", None):
+            kv.drain_pulls()
+
     def get_params(self):
         """reference: module.py get_params"""
         assert self.binded and self.params_initialized
+        self._drain_param_comm()
         arg_params = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
         aux_params = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
         return arg_params, aux_params
@@ -203,6 +213,10 @@ class Module(BaseModule):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing the parameters"
+        # land (and discard) any deferred kvstore pulls NOW: they target
+        # these same executor arrays, and draining after this write
+        # would overwrite the freshly loaded values with stale weights
+        self._drain_param_comm()
 
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
@@ -536,6 +550,9 @@ class Module(BaseModule):
     def forward(self, data_batch, is_train=None):
         """reference: module.py forward → executor forward"""
         assert self.binded and self.params_initialized
+        # parameters are about to be consumed: land any deferred
+        # kvstore pulls from the previous update() first
+        self._drain_param_comm()
         if is_train is None:
             is_train = self.for_training
         self._flushed_backward = False

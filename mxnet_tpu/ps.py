@@ -59,7 +59,8 @@ _U64 = struct.Struct("!Q")
 _I64 = struct.Struct("!q")
 
 # ops
-_INIT, _PUSH, _PULL, _SET_OPT, _NUM_APPLIED, _STOP, _PUSH_SYNC = range(1, 8)
+(_INIT, _PUSH, _PULL, _SET_OPT, _NUM_APPLIED, _STOP, _PUSH_SYNC,
+ _PUSH_MULTI, _PULL_MULTI) = range(1, 10)
 
 
 def bigarray_bound() -> int:
@@ -115,7 +116,12 @@ def _unpack_key(buf: memoryview, off: int):
 
 def _pack_tensor(arr: np.ndarray) -> bytes:
     arr = np.ascontiguousarray(arr)
-    dt = arr.dtype.str.encode()  # e.g. b'<f4' — unambiguous, endian-tagged
+    # '<f4'-style typestrings are unambiguous and endian-tagged, but
+    # extension float dtypes (ml_dtypes bfloat16 — the bf16 gradient
+    # wire) stringify as an opaque '<V2'; ship their registered NAME
+    # ('bfloat16') instead, which np.dtype() resolves on the far side
+    ds = arr.dtype.str
+    dt = (arr.dtype.name if ds.lstrip("<>|=")[0] == "V" else ds).encode()
     if arr.ndim > 0xFF or len(dt) > 0xFF:
         raise MXNetError("tensor rank/dtype out of protocol range")
     head = struct.pack("!B", len(dt)) + dt + struct.pack("!B", arr.ndim)
@@ -123,10 +129,20 @@ def _pack_tensor(arr: np.ndarray) -> bytes:
     return head + arr.tobytes()
 
 
+def _wire_dtype(token: str) -> np.dtype:
+    try:
+        return np.dtype(token)
+    except TypeError:
+        # extension dtype by name ('bfloat16'): registered by ml_dtypes
+        import ml_dtypes  # noqa: F401 — import registers the dtypes
+
+        return np.dtype(token)
+
+
 def _unpack_tensor(buf: memoryview, off: int) -> Tuple[np.ndarray, int]:
     dlen = buf[off]
     off += 1
-    dt = np.dtype(bytes(buf[off:off + dlen]).decode())
+    dt = _wire_dtype(bytes(buf[off:off + dlen]).decode())
     off += dlen
     ndim = buf[off]
     off += 1
@@ -268,70 +284,42 @@ class ParameterServer:
                 off += 4
                 grad, _ = _unpack_tensor(buf, off)
                 with self._cond:
-                    if key not in self._store:
-                        raise MXNetError(f"push to uninitialized key {key}")
-                    if op == _PUSH and not self._sync:
-                        self._apply(key, grad)
-                    else:
-                        # sync: merge; apply once ALL DISTINCT workers
-                        # pushed.  A duplicate push from a worker that
-                        # already contributed belongs to the NEXT round
-                        # — queue it (block this worker's handler thread
-                        # until the open round completes) rather than
-                        # letting it complete the round early with a
-                        # peer's gradient missing.
-                        ok = self._cond.wait_for(
-                            lambda: worker not in self._contrib.get(
-                                key, ()),
-                            timeout=600.0)
-                        if not ok:
-                            raise MXNetError(
-                                f"duplicate push({key}) from worker "
-                                f"{worker} timed out waiting for round "
-                                f"completion (a peer never pushed?)")
-                        self._contrib.setdefault(key, set()).add(worker)
-                        # straggler telemetry: when each worker's push
-                        # for the open round landed
-                        now = time.time()
-                        arrivals = self._arrivals.setdefault(key, {})
-                        if not arrivals:
-                            self._round_open_t[key] = now
-                        arrivals[worker] = now
-                        if key in self._pending:
-                            self._pending[key] = self._pending[key] + grad
-                        else:
-                            self._pending[key] = np.array(
-                                grad, dtype=np.float64
-                                if grad.dtype == np.float64 else np.float32)
-                        if len(self._contrib[key]) >= self._num_workers:
-                            arrivals = self._arrivals.pop(key, {})
-                            self._round_open_t.pop(key, None)
-                            self._round_warned.pop(key, None)
-                            if len(arrivals) > 1:
-                                _prof.observe(
-                                    "ps.round_spread_ms",
-                                    (max(arrivals.values())
-                                     - min(arrivals.values())) * 1e3)
-                            del self._contrib[key]  # open the next round
-                            self._apply(key, self._pending.pop(key))
+                    self._push_one(key, worker, grad,
+                                   sync=(op != _PUSH or self._sync))
+                return b"\x00"
+            if op == _PUSH_MULTI:
+                # one wire frame, many keys (the bucketed gradient
+                # path): per-key semantics are IDENTICAL to N single
+                # pushes from this worker in frame order
+                sync = buf[off] != 0
+                off += 1
+                (worker,) = _U32.unpack_from(buf, off)
+                off += 4
+                (count,) = struct.unpack_from("!H", buf, off)
+                off += 2
+                for _ in range(count):
+                    key, off = _unpack_key(buf, off)
+                    grad, off = _unpack_tensor(buf, off)
+                    with self._cond:
+                        self._push_one(key, worker, grad,
+                                       sync=(sync or self._sync))
                 return b"\x00"
             if op == _PULL:
                 key, off = _unpack_key(buf, off)
                 (min_round,) = _U64.unpack_from(buf, off)
                 with self._cond:
-                    if key not in self._store:
-                        raise MXNetError(f"pull from uninitialized key {key}")
-                    # BSP wait: block until the requested round completed
-                    ok = self._cond.wait_for(
-                        lambda: self._round.get(key, 0) >= min_round,
-                        timeout=600.0)
-                    if not ok:
-                        raise MXNetError(
-                            f"pull({key}) timed out waiting for round "
-                            f"{min_round} (stuck worker?)")
-                    body = (b"\x00" + _U64.pack(self._round[key])
-                            + _pack_tensor(self._store[key]))
-                return body
+                    return b"\x00" + self._pull_one(key, min_round)
+            if op == _PULL_MULTI:
+                (count,) = struct.unpack_from("!H", buf, off)
+                off += 2
+                parts = [b"\x00"]
+                for _ in range(count):
+                    key, off = _unpack_key(buf, off)
+                    (min_round,) = _U64.unpack_from(buf, off)
+                    off += 8
+                    with self._cond:
+                        parts.append(self._pull_one(key, min_round))
+                return b"".join(parts)
             if op == _NUM_APPLIED:
                 key, _ = _unpack_key(buf, off)
                 with self._cond:
@@ -409,6 +397,70 @@ class ParameterServer:
                     arrived, missing)
                 _prof.inc_counter("watchdog.ps_round_timeouts")
 
+    def _push_one(self, key, worker: int, grad: np.ndarray, sync: bool):
+        """Apply/merge ONE key's push — caller holds the lock (the
+        shared body of _PUSH, _PUSH_SYNC and _PUSH_MULTI frames)."""
+        if key not in self._store:
+            raise MXNetError(f"push to uninitialized key {key}")
+        if not sync:
+            self._apply(key, grad)
+            return
+        # sync: merge; apply once ALL DISTINCT workers pushed.  A
+        # duplicate push from a worker that already contributed belongs
+        # to the NEXT round — queue it (block this worker's handler
+        # thread until the open round completes) rather than letting it
+        # complete the round early with a peer's gradient missing.
+        ok = self._cond.wait_for(
+            lambda: worker not in self._contrib.get(key, ()),
+            timeout=600.0)
+        if not ok:
+            raise MXNetError(
+                f"duplicate push({key}) from worker {worker} timed out "
+                "waiting for round completion (a peer never pushed?)")
+        self._contrib.setdefault(key, set()).add(worker)
+        # straggler telemetry: when each worker's push for the open
+        # round landed
+        now = time.time()
+        arrivals = self._arrivals.setdefault(key, {})
+        if not arrivals:
+            self._round_open_t[key] = now
+        arrivals[worker] = now
+        if key in self._pending:
+            # fp32 (or fp64) accumulation regardless of the wire dtype:
+            # a bf16/fp16-compressed gradient is widened on arrival
+            self._pending[key] = self._pending[key] + np.asarray(
+                grad, dtype=self._pending[key].dtype)
+        else:
+            self._pending[key] = np.array(
+                grad, dtype=np.float64
+                if grad.dtype == np.float64 else np.float32)
+        if len(self._contrib[key]) >= self._num_workers:
+            arrivals = self._arrivals.pop(key, {})
+            self._round_open_t.pop(key, None)
+            self._round_warned.pop(key, None)
+            if len(arrivals) > 1:
+                _prof.observe(
+                    "ps.round_spread_ms",
+                    (max(arrivals.values())
+                     - min(arrivals.values())) * 1e3)
+            del self._contrib[key]  # open the next round
+            self._apply(key, self._pending.pop(key))
+
+    def _pull_one(self, key, min_round: int) -> bytes:
+        """Round-gated read of ONE key — caller holds the lock; returns
+        the ``round || tensor`` wire payload (no status byte)."""
+        if key not in self._store:
+            raise MXNetError(f"pull from uninitialized key {key}")
+        # BSP wait: block until the requested round completed
+        ok = self._cond.wait_for(
+            lambda: self._round.get(key, 0) >= min_round,
+            timeout=600.0)
+        if not ok:
+            raise MXNetError(
+                f"pull({key}) timed out waiting for round "
+                f"{min_round} (stuck worker?)")
+        return _U64.pack(self._round[key]) + _pack_tensor(self._store[key])
+
     def _apply(self, key, grad: np.ndarray) -> None:
         """Run the updater (or plain assign) — caller holds the lock."""
         stored = self._store[key]
@@ -443,7 +495,12 @@ _WORKER_IDS = iter(range(1 << 31, 1 << 32))  # auto ids, above real ranks
 
 
 class PSClient:
-    """One persistent connection to one server shard (thread-safe)."""
+    """One persistent connection to one server shard (thread-safe),
+    with a windowed in-flight pipeline: up to MXNET_KVSTORE_INFLIGHT
+    requests may be outstanding before the oldest response is
+    collected.  Responses are matched to requests by FIFO ticket (the
+    server handles one frame at a time per connection, so response
+    order == send order)."""
 
     def __init__(self, host: str, port: int, secret: bytes = b"",
                  timeout: float = 60.0, worker: Optional[int] = None):
@@ -454,7 +511,15 @@ class PSClient:
         # are N distinct workers (the pre-tracking behavior); pass an
         # explicit id to make retries/reconnects count as one worker.
         self._worker = next(_WORKER_IDS) if worker is None else worker
-        self._lock = threading.Lock()
+        # one mutex guards the ticket counters; _lock stays as a public-
+        # ish alias for raw-frame tests that bypass the ticket pipeline
+        self._mu = threading.Lock()
+        self._lock = self._mu
+        self._can_send = threading.Condition(self._mu)
+        self._can_recv = threading.Condition(self._mu)
+        self._sent = 0    # tickets issued (== frames written)
+        self._recvd = 0   # responses consumed
+        self._dead: Optional[BaseException] = None
         import time
 
         t0 = time.time()
@@ -472,24 +537,78 @@ class PSClient:
                 time.sleep(0.2)
 
     def _begin(self, body: bytes):
-        """Send now, collect later: lets ShardedPSClient pipeline one
-        request per shard (send all, then receive all) instead of S
-        serialized round-trips.  The lock is held until the matching
-        ``finish()`` runs — callers must pair every _begin with its
-        finish, and never _begin twice on one client before finishing
-        (ShardedPSClient plans touch each shard at most once per op)."""
-        self._lock.acquire()
-        try:
-            _send_frame(self._sock, body)
-        except BaseException:
-            self._lock.release()
-            raise
+        """Send now, collect later.  Ticketed window: the frame goes out
+        immediately (in ticket order — send happens under the mutex);
+        ``finish()`` reads this ticket's response after every earlier
+        ticket's finisher ran.  Up to the in-flight window of requests
+        may be outstanding, which is what lets ShardedPSClient overlap
+        one request per shard AND the comm scheduler keep several
+        buckets riding one connection.  Every _begin's finisher MUST
+        eventually be called (an abandoned one stalls all later
+        tickets); a socket-level failure poisons the connection for all
+        outstanding tickets."""
+        from .comm import inflight_window
+
+        limit = inflight_window()
+        with self._can_send:
+            while self._sent - self._recvd >= limit and self._dead is None:
+                if not self._can_send.wait(timeout=630.0):
+                    raise MXNetError(
+                        f"parameter server {self._addr}: in-flight window "
+                        "stuck (an earlier finisher was never collected?)")
+            if self._dead is not None:
+                raise MXNetError(
+                    f"parameter server connection {self._addr} is dead: "
+                    f"{self._dead}") from self._dead
+            ticket = self._sent
+            try:
+                _send_frame(self._sock, body)
+            except BaseException as e:
+                self._dead = e
+                self._can_send.notify_all()
+                self._can_recv.notify_all()
+                raise
+            self._sent += 1
 
         def finish() -> memoryview:
+            with self._can_recv:
+                while self._recvd != ticket and self._dead is None:
+                    if not self._can_recv.wait(timeout=630.0):
+                        # an earlier ticket's finisher was abandoned:
+                        # its response will never be read, so the whole
+                        # connection is wedged — poison it NOW so the
+                        # other outstanding tickets (and new _begins)
+                        # fail fast instead of serially waiting 630s
+                        self._dead = MXNetError(
+                            f"response pipeline stuck before ticket "
+                            f"{ticket} (an earlier finisher was never "
+                            "collected)")
+                        self._can_recv.notify_all()
+                        self._can_send.notify_all()
+                        raise MXNetError(
+                            f"parameter server {self._addr}: response "
+                            f"pipeline stuck before ticket {ticket}")
+                if self._dead is not None:
+                    raise MXNetError(
+                        f"parameter server connection {self._addr} is "
+                        f"dead: {self._dead}") from self._dead
+            # the socket read runs OUTSIDE the mutex so later tickets
+            # can keep SENDING (full-duplex) while we wait; only this
+            # ticket may read — successors block until _recvd advances
+            exc = None
+            resp = None
             try:
                 resp = _recv_frame(self._sock)
-            finally:
-                self._lock.release()
+            except BaseException as e:
+                exc = e
+            with self._can_recv:
+                self._recvd += 1
+                if exc is not None:
+                    self._dead = exc
+                self._can_recv.notify_all()
+                self._can_send.notify_all()
+            if exc is not None:
+                raise exc
             if resp[0] != 0:
                 (n,) = struct.unpack_from("!H", resp, 1)
                 raise MXNetError(
@@ -588,11 +707,11 @@ class ShardedPSClient:
     def _fan_out(calls):
         """Pipeline one request per shard: send everything, then
         collect — S overlapped round-trips instead of S serialized
-        ones.  Safe because a plan touches each client at most once
-        (a second _begin on the same client would self-deadlock).
-        EVERY finisher runs even when one raises: an abandoned finisher
-        would leave its client lock held and its response undrained,
-        deadlocking the next op on that shard."""
+        ones.  _begin's ticketed window also allows multiple begins per
+        client (up to MXNET_KVSTORE_INFLIGHT), but a plan still touches
+        each client at most once per op.  EVERY finisher runs even when
+        one raises: an abandoned finisher would stall all later tickets
+        on its connection, deadlocking the next op on that shard."""
         finishers = []
         try:
             for cl, body, extra in calls:
@@ -648,6 +767,108 @@ class ShardedPSClient:
 
     def push_sync(self, key, grad: np.ndarray):
         self._push(key, grad, sync=True)
+
+    # -- bucketed multi-key ops (one wire frame per shard) --------------
+    def begin_push_multi(self, entries, sync: bool = False):
+        """Send one _PUSH_MULTI frame per shard covering every (key,
+        grad) in ``entries`` (big arrays still split flat across all
+        shards); returns the list of finishers — the send-now/collect-
+        later half the comm scheduler windows.  Per-key semantics are
+        identical to len(entries) single pushes in order."""
+        per_client: Dict[Any, List] = {}
+        total = 0
+        for key, grad in entries:
+            grad = np.asarray(grad)
+            total += grad.nbytes
+            flat = grad.reshape(-1)
+            for cl, wk, a, b in self._plan(key, grad.size):
+                per_client.setdefault(cl, []).append(
+                    (wk, flat[a:b] if (a, b) != (0, grad.size) else grad))
+        finishers = []
+        try:
+            for cl, items in per_client.items():
+                if len(items) > 0xFFFF:
+                    raise MXNetError(
+                        f"push_multi: {len(items)} keys for one shard "
+                        "exceeds the u16 frame limit — lower "
+                        "MXNET_KVSTORE_BUCKET_BYTES (the comm "
+                        "scheduler's MAX_BUCKET_KEYS cap should make "
+                        "this unreachable)")
+                body = bytearray([_PUSH_MULTI, 1 if sync else 0])
+                body += _U32.pack(cl._worker)
+                body += struct.pack("!H", len(items))
+                for wk, arr in items:
+                    body += _pack_key(wk) + _pack_tensor(arr)
+                finishers.append(cl._begin(bytes(body)))
+        except BaseException:
+            for fin in finishers:
+                try:
+                    fin()
+                except Exception:  # noqa: BLE001 — drain before re-raise
+                    pass
+            raise
+        _prof.inc_counter("kvstore.wire_bytes", float(total))
+        return finishers
+
+    def push_multi(self, entries, sync: bool = False):
+        """Blocking wrapper over :meth:`begin_push_multi`."""
+        from .comm import finish_all
+
+        with _prof.scope("ps.push_multi", "comm",
+                         args={"keys": len(entries), "sync": sync}):
+            finish_all(self.begin_push_multi(entries, sync=sync))
+
+    def pull_multi(self, specs):
+        """Batched pull: ``specs`` is a list of (key, shape, dtype,
+        min_round); one _PULL_MULTI frame per shard moves every
+        requested key, responses reassembled per key.  Returns arrays
+        in spec order."""
+        results: List[Optional[np.ndarray]] = [None] * len(specs)
+        per_client: Dict[Any, List] = {}
+        metas = []
+        for idx, (key, shape, dtype, min_round) in enumerate(specs):
+            size = (int(np.prod(shape)) if shape is not None
+                    else self._sizes.get(key, 0))
+            plan = self._plan(key, size)
+            out = None
+            if len(plan) > 1:
+                if shape is None:
+                    raise MXNetError("pull of a split key needs the shape")
+                out = np.empty(size, dtype=np.dtype(dtype)
+                               if dtype else np.float32)
+            metas.append((out, shape))
+            for cl, wk, a, b in plan:
+                per_client.setdefault(cl, []).append(
+                    (wk, int(min_round), idx, a, b))
+        calls = []
+        for cl, items in per_client.items():
+            if len(items) > 0xFFFF:
+                raise MXNetError(
+                    f"pull_multi: {len(items)} keys for one shard "
+                    "exceeds the u16 frame limit — split the request")
+            body = bytearray([_PULL_MULTI])
+            body += struct.pack("!H", len(items))
+            for wk, mr, _idx, _a, _b in items:
+                body += _pack_key(wk) + _U64.pack(mr)
+            calls.append((cl, bytes(body), items))
+        with _prof.scope("ps.pull_multi", "comm",
+                         args={"keys": len(specs), "shards": len(calls)}):
+            for resp, items in self._fan_out(calls):
+                roff = 1
+                for _wk, _mr, idx, a, b in items:
+                    roff += 8  # per-key round counter
+                    arr, roff = _unpack_tensor(resp, roff)
+                    out, shape = metas[idx]
+                    if out is None:
+                        results[idx] = np.array(
+                            arr.reshape(shape) if shape is not None
+                            else arr)
+                    else:
+                        out[a:b] = arr.reshape(-1)
+        for idx, (out, shape) in enumerate(metas):
+            if out is not None:
+                results[idx] = out.reshape(shape)
+        return results
 
     def pull(self, key, shape=None, dtype=None, min_round: int = 0):
         size = (int(np.prod(shape)) if shape is not None

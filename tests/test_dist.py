@@ -262,6 +262,71 @@ def test_telemetry_traces_and_watchdog(tmp_path):
                for e in evs if e.get("ph") == "X"), names
 
 
+def test_comm_overlap_trace(tmp_path):
+    """The bucketed-async-comm acceptance path: 2 real processes push
+    through the comm scheduler under a small bucket cap; the merged
+    trace must show ``kvstore.bucket`` spans (comm thread) running
+    WHILE the main thread is inside compute spans — the explicit
+    overlap.compute window first (impossible on the blocking path,
+    where every allgather completes before push() returns), then under
+    Module.fit's fit.step timeline — and both ranks end with identical
+    weights.  A bf16-wire phase inside the worker checks compressed
+    payloads still sum exactly."""
+    import json
+    import re
+
+    trace_dir = str(tmp_path / "traces")
+    env = _worker_env()
+    env["MXNET_KVSTORE_BUCKET_BYTES"] = "65536"  # force several buckets
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--cpu",
+         sys.executable,
+         os.path.join(REPO, "tests", "dist_overlap_worker.py"), trace_dir],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+    out = r.stdout + r.stderr
+    assert r.returncode == 0, out
+    digests = re.findall(r"comm overlap OK digest=([\d.]+)", out)
+    assert len(digests) == 2, out
+    assert digests[0] == digests[1], f"weight digests differ: {digests}"
+
+    merged = str(tmp_path / "merged.json")
+    rm = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_merge.py"),
+         trace_dir, "-o", merged],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert rm.returncode == 0, rm.stdout + rm.stderr
+    with open(merged) as f:
+        evs = [e for e in json.load(f)["traceEvents"]
+               if e.get("ph") == "X"]
+
+    def spans(pid, name):
+        return [(e["ts"], e["ts"] + e["dur"], e.get("tid"))
+                for e in evs if e["pid"] == pid and e["name"] == name]
+
+    for pid in (0, 1):
+        buckets = spans(pid, "kvstore.bucket")
+        assert buckets, f"rank {pid}: no kvstore.bucket spans"
+        # bucket spans carry byte counts for the viewer detail pane
+        assert any(e.get("args", {}).get("bytes")
+                   for e in evs if e["pid"] == pid
+                   and e["name"] == "kvstore.bucket")
+        # (1) comm runs on another thread DURING the explicit compute
+        # window issued after the pushes already returned
+        (c0, c1, ctid), = spans(pid, "overlap.compute")
+        overlapping = [b for b in buckets
+                       if b[0] < c1 and b[1] > c0 and b[2] != ctid]
+        assert overlapping, (
+            f"rank {pid}: no comm-thread kvstore.bucket span inside "
+            f"the overlap.compute window [{c0}, {c1}]: {buckets}")
+        # (2) comm rides under the training-step timeline too
+        steps = spans(pid, "fit.step")
+        assert steps, f"rank {pid}: no fit.step spans"
+        assert any(b[0] < s1 and b[1] > s0
+                   for b in buckets for (s0, s1, _t) in steps), (
+            f"rank {pid}: no kvstore.bucket span overlaps any fit.step")
+
+
 def test_launch_two_process_dist_async():
     """Real async consistency: unequal push rates, pulls without
     rendezvous, every push applied on arrival (reference:

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Gradient-communication benchmark: bucketed + overlapped push/pull
+(the comm scheduler) vs the per-key blocking path, over a real
+in-process parameter-server cluster (TCP loopback — the same wire
+protocol and client machinery the dist kvstore uses).
+
+Prints ONE JSON line (the `bench.py` convention, same p50/p90/p99+rates
+vocabulary as bench_serving / metrics_summary):
+
+  {"metric": "comm_throughput", "value": N, "unit": "MB/s",
+   "bytes_s": N, "p50_ms": N, "p90_ms": N, "p99_ms": N,
+   "overlap_ratio": N, "vs_serial": N, "sweep": [...], ...}
+
+Methodology (PERF.md appendix "Gradient communication benchmark"):
+- Closed loop: each round pushes every key's gradient and pulls every
+  key's weight back — the `_update_params_on_kvstore` traffic pattern.
+  Round latency is wall-clock around the full push→(overlap)→pull.
+- The workload is MANY SMALL KEYS (the transformer/ResNet bias+norm
+  regime the per-key path is worst at): COMM_KEYS keys of
+  COMM_KEY_BYTES each.
+- serial = per-key blocking `ShardedPSClient.push` then `pull`, key
+  order — exactly what DistKVStore did before the scheduler.
+- bucketed = CommScheduler over the same cluster: pushes submit
+  (bucketed, async, windowed multi-key frames), the main thread then
+  runs a simulated optimizer/compute slice (COMM_COMPUTE_MS of host
+  work — the step remainder the comm is supposed to hide under),
+  drains, and issues ONE batched pull_multi.
+- overlap_ratio = 1 - blocked_s/busy_s: the fraction of comm-thread
+  busy time hidden behind main-thread work (1.0 = fully hidden,
+  0 = the main thread waited out every comm second).
+- vs_serial = serial_round_mean / bucketed_round_mean on the same
+  workload — the acceptance number (>1 means bucketed+async wins).
+
+Env knobs: COMM_KEYS (default 128), COMM_KEY_BYTES (default 8192),
+COMM_ROUNDS (default 20), COMM_SERVERS (default 2), COMM_COMPUTE_MS
+(default 20.0), COMM_BUCKET_KB sweep (default "64,256,1024"),
+COMM_GRAD_DTYPE (default fp32; bf16 halves wire bytes).
+"""
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np
+
+
+def log(msg):
+    print(f"[bench_comm] {msg}", file=sys.stderr, flush=True)
+
+
+def _pct(vals, q):
+    return round(float(np.percentile(vals, q)), 3)
+
+
+def make_cluster(n_servers, n_keys, key_elems):
+    from mxnet_tpu.ps import ParameterServer, ShardedPSClient
+
+    secret = b"bench"
+    servers = [ParameterServer(secret=secret, sync=False, num_workers=1)
+               for _ in range(n_servers)]
+    client = ShardedPSClient([("127.0.0.1", s.port) for s in servers],
+                             secret=secret, worker=0)
+    keys = [f"g{i}" for i in range(n_keys)]
+    for k in keys:
+        client.init(k, np.zeros(key_elems, np.float32))
+    return servers, client, keys
+
+
+def bench_serial(client, keys, grads, rounds, compute_ms):
+    """Per-key blocking push then pull, key order — the pre-scheduler
+    DistKVStore wire pattern.  One untimed warm round first (connection
+    buffers, server dict growth) — compile/setup one-offs are not a
+    steady-state comm-rate term, same convention as bench_serving."""
+    for k, g in zip(keys, grads):
+        client.push(k, g)
+        client.pull(k, shape=g.shape, dtype=g.dtype)
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        t1 = time.perf_counter()
+        for k, g in zip(keys, grads):
+            client.push(k, g)
+        _compute(compute_ms)
+        for k, g in zip(keys, grads):
+            client.pull(k, shape=g.shape, dtype=g.dtype)
+        lat.append((time.perf_counter() - t1) * 1e3)
+    wall = time.perf_counter() - t0
+    return lat, wall
+
+
+def _compute(ms):
+    """Simulated optimizer/metric/io host work the comm should hide
+    under (busy host loop, not sleep — sleep would overlap trivially)."""
+    if ms <= 0:
+        return
+    a = np.random.rand(64, 64)
+    t_end = time.perf_counter() + ms / 1e3
+    while time.perf_counter() < t_end:
+        a = a @ a
+        a /= np.abs(a).max() + 1e-9
+
+
+def bench_bucketed(client, keys, grads, rounds, compute_ms, bucket_bytes):
+    """CommScheduler over the same cluster: async bucketed pushes,
+    compute slice, drain, one batched pull — comm.make_ps_launch is
+    the SAME transport DistKVStore runs."""
+    from mxnet_tpu import comm
+
+    sched = comm.CommScheduler(comm.make_ps_launch(client),
+                               strict_order=False,
+                               max_bucket_bytes=bucket_bytes,
+                               name="bench-comm")
+    specs = [(k, g.shape, g.dtype, 0) for k, g in zip(keys, grads)]
+    lat = []
+    try:
+        # untimed warm round: pays the one-off pack compile (the jitted
+        # concatenate per bucket shape) + scheduler thread spin-up
+        for i, (k, g) in enumerate(zip(keys, grads)):
+            sched.submit(k, g, priority=-i)
+        sched.drain()
+        client.pull_multi(specs)
+        sched.busy_s = sched.blocked_s = 0.0
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            t1 = time.perf_counter()
+            for i, (k, g) in enumerate(zip(keys, grads)):
+                sched.submit(k, g, priority=-i)
+            sched.flush()
+            _compute(compute_ms)
+            sched.drain()
+            client.pull_multi(specs)
+            lat.append((time.perf_counter() - t1) * 1e3)
+        wall = time.perf_counter() - t0
+        busy, blocked = sched.busy_s, sched.blocked_s
+    finally:
+        sched.close()
+    overlap = max(0.0, 1.0 - blocked / busy) if busy > 0 else 0.0
+    return lat, wall, overlap
+
+
+def main():
+    n_keys = int(os.environ.get("COMM_KEYS", "128"))
+    key_bytes = int(os.environ.get("COMM_KEY_BYTES", "8192"))
+    rounds = int(os.environ.get("COMM_ROUNDS", "20"))
+    n_servers = int(os.environ.get("COMM_SERVERS", "2"))
+    compute_ms = float(os.environ.get("COMM_COMPUTE_MS", "20.0"))
+    bucket_kbs = [int(x) for x in os.environ.get(
+        "COMM_BUCKET_KB", "64,256,1024").split(",") if x.strip()]
+    wire = os.environ.get("COMM_GRAD_DTYPE")
+    if wire:
+        os.environ["MXNET_KVSTORE_GRAD_DTYPE"] = wire
+
+    key_elems = max(1, key_bytes // 4)
+    rng = np.random.RandomState(11)
+    grads = [rng.randn(key_elems).astype(np.float32) for _ in range(n_keys)]
+    total_bytes = sum(g.nbytes for g in grads) * 2  # push + pull payload
+
+    log(f"keys={n_keys} x {key_bytes}B, rounds={rounds}, "
+        f"servers={n_servers}, compute={compute_ms}ms, "
+        f"buckets_kb={bucket_kbs}, wire={wire or 'fp32'}")
+    servers, client, keys = make_cluster(n_servers, n_keys, key_elems)
+    try:
+        # warm both paths (connections, server dicts)
+        client.push_multi([(k, g) for k, g in zip(keys, grads)][:4])
+        ser_lat, ser_wall = bench_serial(client, keys, grads, rounds,
+                                         compute_ms)
+        ser_mean = float(np.mean(ser_lat))
+        log(f"serial per-key: {ser_mean:.2f} ms/round "
+            f"(p99 {_pct(ser_lat, 99)} ms)")
+
+        sweep = []
+        for kb in bucket_kbs:
+            lat, wall, overlap = bench_bucketed(
+                client, keys, grads, rounds, compute_ms, kb << 10)
+            mean = float(np.mean(lat))
+            pt = {
+                "bucket_kb": kb,
+                "bytes_s": round(total_bytes * rounds / wall, 1),
+                "round_ms": round(mean, 3),
+                "p50_ms": _pct(lat, 50),
+                "p90_ms": _pct(lat, 90),
+                "p99_ms": _pct(lat, 99),
+                "overlap_ratio": round(overlap, 3),
+                "vs_serial": round(ser_mean / mean, 3),
+            }
+            sweep.append(pt)
+            log(f"bucketed {kb:5d} KiB: {mean:.2f} ms/round "
+                f"(x{pt['vs_serial']:.2f} serial, overlap "
+                f"{overlap:.2f}, p99 {pt['p99_ms']} ms)")
+    finally:
+        client.close()
+        for s in servers:
+            s.close()
+
+    best = max(sweep, key=lambda p: p["vs_serial"])
+    print(json.dumps({
+        "metric": "comm_throughput",
+        "value": round(best["bytes_s"] / 1e6, 2),
+        "unit": "MB/s",
+        "bytes_s": best["bytes_s"],
+        "p50_ms": best["p50_ms"],
+        "p90_ms": best["p90_ms"],
+        "p99_ms": best["p99_ms"],
+        "overlap_ratio": best["overlap_ratio"],
+        "vs_serial": best["vs_serial"],
+        "serial_round_ms": round(ser_mean, 3),
+        "serial_p99_ms": _pct(ser_lat, 99),
+        "best_bucket_kb": best["bucket_kb"],
+        "keys": n_keys,
+        "key_bytes": key_bytes,
+        "rounds": rounds,
+        "servers": n_servers,
+        "compute_ms": compute_ms,
+        "wire_dtype": wire or "fp32",
+        "sweep": sweep,
+    }))
+
+
+if __name__ == "__main__":
+    main()
