@@ -55,7 +55,14 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Log samples/sec every `frequent` batches (reference: callback.py:89).
+    """Log samples/sec every ``frequent`` batches (behavior parity with
+    reference callback.py:89, pinned by tests/test_callback.py).
+
+    The first call after construction (or after the batch counter
+    rewinds at an epoch boundary) only opens the timing window — no
+    report.  Thereafter a report fires whenever ``nbatch`` is a
+    multiple of ``frequent``, rating the ``frequent * batch_size``
+    samples of the window just closed.
 
     ``auto_reset=True`` resets the metric each report (the reference's
     windowed behavior); ``False`` leaves the metric accumulating over
@@ -65,45 +72,48 @@ class Speedometer:
         self.batch_size = batch_size
         self.frequent = frequent
         self.auto_reset = auto_reset
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self._window_open_t = None  # None = no window yet (epoch start)
+        self._prev_nbatch = 0
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
+        nbatch = param.nbatch
+        if nbatch < self._prev_nbatch:
+            self._window_open_t = None  # counter rewound: new epoch
+        self._prev_nbatch = nbatch
 
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    for name, value in name_value:
-                        logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\tTrain-%s=%f",
-                                     param.epoch, count, speed, name, value)
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        if self._window_open_t is None:
+            self._window_open_t = time.time()
+            return
+        if nbatch % self.frequent:
+            return
+        elapsed = time.time() - self._window_open_t
+        rate = self.frequent * self.batch_size / max(elapsed, 1e-12)
+        metric = getattr(param, "eval_metric", None)
+        if metric is None:
+            logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                         param.epoch, nbatch, rate)
         else:
-            self.init = True
-            self.tic = time.time()
+            pairs = metric.get_name_value()
+            if self.auto_reset:
+                metric.reset()
+            for name, value in pairs:
+                logging.info(
+                    "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                    "\tTrain-%s=%f", param.epoch, nbatch, rate, name, value)
+        self._window_open_t = time.time()
 
 
 class ProgressBar:
-    """ASCII progress bar (reference: callback.py:137)."""
+    """ASCII progress bar over ``total`` batches (behavior parity with
+    reference callback.py:137: same [=-] glyphs and ceil'd percent, so
+    downstream terminal scrapers see identical frames)."""
 
     def __init__(self, total, length=80):
         self.bar_len = length
         self.total = total
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        sys.stdout.write(f"[{prog_bar}] {percents}%\r")
+        frac = param.nbatch / float(self.total)
+        filled = int(round(self.bar_len * frac))
+        cells = ("=" if i < filled else "-" for i in range(self.bar_len))
+        sys.stdout.write("[%s] %d%%\r" % ("".join(cells), math.ceil(100.0 * frac)))

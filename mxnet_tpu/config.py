@@ -62,6 +62,17 @@ register_env(
     "The reference's gradient-mirroring flag "
     "(graph_executor.cc:199-212).")
 register_env(
+    "MXNET_ZERO", 1, int,
+    "1 (default): when a device mesh with dp>1 is active, the fused "
+    "training step runs the ZeRO-1 sharded-optimizer update — "
+    "gradients reduce-scattered over 'dp', Adam/momentum slots stored "
+    "and updated on the local 1/dp shard only, parameters all-gathered "
+    "back in-program (Rajbhandari et al., 2020 stage 1).  Cuts "
+    "per-device optimizer-state bytes and update FLOPs ~dp×; see "
+    "tools/bench_zero.py.  0: replicate the optimizer state and the "
+    "update on every device (the pre-ZeRO behavior).  Checkpointed "
+    "optimizer states are layout-independent either way.")
+register_env(
     "MXNET_CONV_LAYOUT", "NCHW", str,
     "Internal lowering layout for 2-D Convolution: 'NCHW' (default, "
     "direct) or 'NHWC' (channels-last dimension numbers with "

@@ -1,7 +1,12 @@
 """Learning-rate schedulers.
 
-Parity with ``python/mxnet/lr_scheduler.py`` (135 LoC): LRScheduler,
-FactorScheduler, MultiFactorScheduler.
+Behavior parity with ``python/mxnet/lr_scheduler.py`` (135 LoC):
+LRScheduler, FactorScheduler, MultiFactorScheduler.  The schedules are
+re-derived from the spec (pinned by tests/test_optimizer.py): a
+scheduler maps ``num_update`` → lr, mutating ``base_lr`` as decay
+boundaries are crossed so an external rebase of ``base_lr`` (the
+optimizer writes it at construction) restarts the decay chain from the
+current position.
 """
 
 from __future__ import annotations
@@ -22,59 +27,83 @@ class LRScheduler:
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates (reference: lr_scheduler.py:33)."""
+    """lr *= factor once per ``step`` updates, floored at
+    ``stop_factor_lr`` (reference: lr_scheduler.py:33).
+
+    A decay fires the first time ``num_update`` strictly exceeds
+    ``count + step``; ``count`` then advances by ``step``.  Calls are
+    lazy — one call may apply several overdue decays at once.
+    """
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8):
         super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1 round")
+            raise ValueError(
+                f"FactorScheduler: step must be a positive update count, "
+                f"got {step}")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError(
+                f"FactorScheduler: factor {factor} > 1 would GROW the lr; "
+                f"use a factor in (0, 1]")
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
         self.count = 0
 
     def __call__(self, num_update):
-        while num_update > self.count + self.step:
+        # boundaries crossed since the last applied decay: each window
+        # of `step` updates past `count` owes one multiplication
+        overdue = max(0, num_update - self.count - 1) // self.step
+        for _ in range(overdue):
             self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
+            decayed = self.base_lr * self.factor
+            if decayed < self.stop_factor_lr:
                 self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update, self.base_lr)
+                logging.info(
+                    "update %d: lr hit the stop_factor_lr floor %.5e; "
+                    "no further decay", num_update, self.base_lr)
             else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
+                self.base_lr = decayed
+                logging.info("update %d: lr decayed to %.5e",
                              num_update, self.base_lr)
         return self.base_lr
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at given steps (reference: lr_scheduler.py:83)."""
+    """lr *= factor as each milestone in ``step`` is passed
+    (reference: lr_scheduler.py:83).  A milestone ``s`` fires the first
+    time ``num_update`` strictly exceeds ``s``; like FactorScheduler,
+    several overdue milestones apply in one call."""
 
     def __init__(self, step, factor=1):
         super().__init__()
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing integer list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1 round")
+        assert isinstance(step, list) and step, \
+            "MultiFactorScheduler: step must be a non-empty list of " \
+            "update milestones"
+        for i, s in enumerate(step):
+            if s < 1:
+                raise ValueError(
+                    f"MultiFactorScheduler: milestone {s} is not a "
+                    f"positive update count")
+            if i and s <= step[i - 1]:
+                raise ValueError(
+                    f"MultiFactorScheduler: milestones must be strictly "
+                    f"increasing, got {step[i - 1]} before {s}")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError(
+                f"MultiFactorScheduler: factor {factor} > 1 would GROW "
+                f"the lr; use a factor in (0, 1]")
         self.step = step
         self.cur_step_ind = 0
         self.factor = factor
         self.count = 0
 
     def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
+        while self.cur_step_ind < len(self.step) \
+                and num_update > self.step[self.cur_step_ind]:
+            self.count = self.step[self.cur_step_ind]
+            self.cur_step_ind += 1
+            self.base_lr *= self.factor
+            logging.info("update %d: lr decayed to %.5e (milestone %d)",
+                         num_update, self.base_lr, self.count)
         return self.base_lr

@@ -129,6 +129,14 @@ class Module(BaseModule):
         self._fused_step = None
         self._fused_warm = False  # first fused run = compile (telemetry)
         self._fused_state = None
+        # ZeRO-1 (MXNET_ZERO): optimizer state sharded over the 'dp'
+        # mesh axis; grads reduce-scattered, update on the local shard,
+        # params all-gathered — all inside the one fused program.
+        self._zero = False
+        self._zero_meta = None  # {name: (flat_size, dp_padded_size)}
+        # optimizer states loaded from a checkpoint before the fused
+        # programs were built: host trees, placed at _ensure_fused_built
+        self._pending_fused_states = None
         self._pending_batch = None
         self._step_count = 0
         self._flushed_backward = False
@@ -364,6 +372,18 @@ class Module(BaseModule):
                     for (i, _ix) in n.inputs:
                         if i.is_variable:
                             groups.setdefault(i.name, g)
+        # a '__shard__' attr on an OP (e.g. FullyConnected(...,
+        # attr=shard_attr('tp', 0))) is a hint for the op's own
+        # parameters — without this, only explicit Variable attrs
+        # shard, and an op-level request silently replicates
+        op_shards = {}
+        for n in self._symbol._topo():
+            s = n._meta.get("__shard__", n.attrs.get("__shard__"))
+            if not s or n.is_variable:
+                continue
+            for (i, _ix) in n.inputs:
+                if i.is_variable:
+                    op_shards.setdefault(i.name, s)
         for name, shapes in (self._data_shapes or []):
             plan.check_batch(shapes[plan.batch_axis] if shapes else 0)
         spans = plan.spans_processes
@@ -398,6 +418,14 @@ class Module(BaseModule):
                     continue
             else:
                 shard = attrs.get(name, {}).get("__shard__")
+                if shard is None and name in op_shards:
+                    # op-level hint is best-effort per param: a bias
+                    # can't shard on the matrix dim — replicate it
+                    shard = op_shards[name]
+                    parts = str(shard).split(":")
+                    if len(parts) == 2 and parts[1].isdigit() \
+                            and int(parts[1]) >= arr.ndim:
+                        shard = None
                 if shard is None and name in groups:
                     shard = plan.group2ctx.get(groups[name])
                     if shard is not None:
@@ -473,6 +501,13 @@ class Module(BaseModule):
             batch_size = self._data_shapes[0][1][0]
             if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
                 batch_size *= kvstore.num_workers
+            elif self._mesh_plan is not None \
+                    and self._mesh_plan.spans_processes:
+                # ONE global program: the in-program psum sums the
+                # GLOBAL batch (local × batch_scale), so the default
+                # 1/batch rescale must use the global count — same
+                # correction the dist_sync branch above applies
+                batch_size *= self._mesh_plan.batch_scale
             idx2name = {i: n for i, n in enumerate(self._param_names)}
             optimizer_params = dict(optimizer_params)
             if "rescale_grad" not in optimizer_params:
@@ -536,10 +571,15 @@ class Module(BaseModule):
             return  # nothing device-resident was built yet
         if self._fused_step is None:
             # build only the jitted programs; the state slots come from
-            # the donor (allocating fresh ones here would be dead work)
+            # the donor (allocating fresh ones here would be dead work).
+            # The donor's ZeRO mode/layout is inherited verbatim — the
+            # adopted state arrays carry its sharded layout, so the
+            # programs built here must consume that same layout
             self._grad_param_names = [
                 n for n in self._param_names
                 if self._exec.grad_req.get(n, "null") != "null"]
+            self._zero = other._zero
+            self._zero_meta = other._zero_meta
             self._fused_step = self._build_fused_step()
             self._apply_grads = self._build_apply_grads()
         self._fused_state = other._fused_state
@@ -657,12 +697,8 @@ class Module(BaseModule):
         import jax.numpy as jnp
 
         graph_fn = self._exec._graph_fn
-        pnames = list(self._grad_param_names)
-        optimizer = self._optimizer
-        lr_mult = {n: optimizer.lr_mult.get(n, 1.0) for n in pnames}
-        wd_mult = {n: optimizer.wd_mult.get(n, 1.0) for n in pnames}
-
         do_mirror = self._exec._do_mirror
+        update = self._make_param_update()
 
         def step(params, fixed, aux, states, inputs, key, lr, t):
             # per-step PRNG derived on device from the base key + int32
@@ -685,19 +721,82 @@ class Module(BaseModule):
             heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             grads = vjp_fn(heads)[0]
             t_f = (t + 1).astype(jnp.float32)
-            new_params = {}
-            new_states = {}
-            for n in pnames:
-                w, s = optimizer.apply(params[n], grads[n], states[n],
-                                       lr * lr_mult[n],
-                                       optimizer.wd * wd_mult[n], t_f)
-                # the f32 lr scalar must not promote low-precision params
-                new_params[n] = w.astype(params[n].dtype)
-                new_states[n] = jax.tree_util.tree_map(
-                    lambda new, old: new.astype(old.dtype), s, states[n])
+            new_params, new_states = update(params, grads, states, lr, t_f)
             return list(outs), new_params, new_aux, new_states, t + 1
 
         return jax.jit(step, donate_argnums=(0, 3, 7))
+
+    def _make_param_update(self):
+        """The optimizer segment of the fused program, shared by
+        _build_fused_step and _build_apply_grads: (params, grads,
+        states, lr, t_f) → (new_params, new_states).
+
+        Replicated mode (default off-mesh): ``optimizer.apply`` runs on
+        every full parameter on every device — the state and the update
+        FLOPs are duplicated dp times.
+
+        ZeRO-1 mode (``self._zero``): every grad/param is flattened to
+        a dp-padded 1-D array and pinned to the 'dp'-sharded layout, so
+        XLA lowers the gradient psum + slice into a reduce-scatter;
+        ``optimizer.apply`` then touches only the local 1/dp shard
+        (sharded state, 1/dp of the update FLOPs and state bytes per
+        device); pinning the result back to the parameter's own layout
+        (replicated, or 'tp'-sharded) lowers to an all-gather.  The
+        update math is elementwise, so sharded and replicated runs
+        agree bit-for-bit up to fp reassociation of the gradient
+        reduction (see tests/test_zero.py)."""
+        import jax
+        import jax.numpy as jnp
+
+        optimizer = self._optimizer
+        pnames = list(self._grad_param_names)
+        lr_mult = {n: optimizer.lr_mult.get(n, 1.0) for n in pnames}
+        wd_mult = {n: optimizer.wd_mult.get(n, 1.0) for n in pnames}
+
+        if not self._zero:
+            def update(params, grads, states, lr, t_f):
+                new_params = {}
+                new_states = {}
+                for n in pnames:
+                    w, s = optimizer.apply(params[n], grads[n], states[n],
+                                           lr * lr_mult[n],
+                                           optimizer.wd * wd_mult[n], t_f)
+                    # the f32 lr scalar must not promote low-precision
+                    # params
+                    new_params[n] = w.astype(params[n].dtype)
+                    new_states[n] = jax.tree_util.tree_map(
+                        lambda new, old: new.astype(old.dtype), s, states[n])
+                return new_params, new_states
+
+            return update
+
+        wsc = jax.lax.with_sharding_constraint
+        meta = self._zero_meta
+        dp_sh = self._mesh_plan.opt_state_sharding()
+        own_sh = {n: self._exec.arg_dict[n]._data.sharding for n in pnames}
+        shapes = {n: tuple(self._exec.arg_dict[n].shape) for n in pnames}
+
+        def update(params, grads, states, lr, t_f):
+            new_params = {}
+            new_states = {}
+            for n in pnames:
+                size, padded = meta[n]
+                gf = wsc(jnp.pad(jnp.reshape(grads[n], (size,)),
+                                 (0, padded - size)), dp_sh)  # reduce-scatter
+                wf = wsc(jnp.pad(jnp.reshape(params[n], (size,)),
+                                 (0, padded - size)), dp_sh)  # local slice
+                w, s = optimizer.apply(wf, gf, states[n],
+                                       lr * lr_mult[n],
+                                       optimizer.wd * wd_mult[n], t_f)
+                w = w.astype(params[n].dtype)
+                new_states[n] = jax.tree_util.tree_map(
+                    lambda new, old: new.astype(old.dtype), s, states[n])
+                # pad lanes (grad 0, state 0) never reach the weights
+                new_params[n] = wsc(jnp.reshape(w[:size], shapes[n]),
+                                    own_sh[n])  # all-gather
+            return new_params, new_states
+
+        return update
 
     def _ensure_fused_built(self, dev):
         import jax
@@ -709,11 +808,12 @@ class Module(BaseModule):
             return
         self._grad_param_names = [n for n in self._param_names
                                   if self._exec.grad_req.get(n, "null") != "null"]
+        self._init_zero_mode()
         self._fused_step = self._build_fused_step()
         self._apply_grads = self._build_apply_grads()
-        self._fused_state = {
-            n: self._optimizer.init_state_arrays(self._exec.arg_dict[n]._data)
-            for n in self._grad_param_names}
+        self._fused_state = self._build_fused_state(dev)
+        _prof.set_gauge("executor.opt_state_bytes",
+                        self._opt_state_bytes_per_device())
         # device-resident step counter + base PRNG key: donated and
         # returned by the step so steady state does zero scalar
         # host→device transfers.  On a mesh they live replicated.
@@ -734,6 +834,109 @@ class Module(BaseModule):
                 self._fused_t = jnp.int32(self._step_count)
             self._fused_key = jax.device_put(_random.next_key(), dev)
         self._lr_cache = {}
+
+    def _init_zero_mode(self):
+        """Decide whether this module's fused step runs the ZeRO-1
+        sharded-optimizer update (MXNET_ZERO, default on whenever a
+        MeshPlan with dp>1 is active) and precompute the flat dp-padded
+        layout of every trainable param."""
+        from ..base import get_env
+
+        plan = self._mesh_plan
+        self._zero = bool(plan is not None and plan.dp > 1
+                          and get_env("MXNET_ZERO", 1, int))
+        self._zero_meta = None
+        if not self._zero:
+            return
+        self._zero_meta = {}
+        for n in self._grad_param_names:
+            size = int(np.prod(self._exec.arg_dict[n].shape, dtype=np.int64))
+            self._zero_meta[n] = (size, plan.zero_padded_size(size))
+
+    def _build_fused_state(self, dev):
+        """Allocate (or restore from a loaded checkpoint) the device-
+        resident optimizer state for every trainable param — flat
+        'dp'-sharded in ZeRO mode, weight-shaped otherwise."""
+        import jax
+        import jax.numpy as jnp
+
+        pending = self._pending_fused_states
+        self._pending_fused_states = None
+        loaded = pending[1] if pending else {}
+        states = {}
+        fresh = []
+        for n in self._grad_param_names:
+            if n in loaded:
+                states[n] = self._place_state_tree(n, loaded[n], dev)
+            elif self._zero:
+                fresh.append(n)
+            else:
+                states[n] = self._optimizer.init_state_arrays(
+                    self._exec.arg_dict[n]._data)
+        if fresh:
+            # ONE jitted builder for every fresh sharded state — a
+            # per-param jit would pay one XLA compile per parameter
+            meta = self._zero_meta
+            dp_sh = self._mesh_plan.opt_state_sharding()
+            optimizer = self._optimizer
+
+            def build(ws):
+                out = {}
+                for n, w in ws.items():
+                    size, padded = meta[n]
+                    wf = jax.lax.with_sharding_constraint(
+                        jnp.pad(jnp.reshape(w, (size,)),
+                                (0, padded - size)), dp_sh)
+                    out[n] = optimizer.init_state_arrays_sharded(wf, dp_sh)
+                return out
+
+            states.update(jax.jit(build)(
+                {n: self._exec.arg_dict[n]._data for n in fresh}))
+        return states
+
+    def _place_state_tree(self, name, host_tree, dev):
+        """Host (param-shaped) state tree → device arrays in this
+        module's current optimizer-state layout.  Checkpoints always
+        store param-shaped full values, so a sharded-mode run re-flattens
+        and scatters while a replicated-mode run places directly —
+        states saved under either layout load under either."""
+        import jax
+
+        plan = self._mesh_plan
+        if self._zero:
+            size, padded = self._zero_meta[name]
+            dp_sh = plan.opt_state_sharding()
+
+            def put(a):
+                flat = np.pad(np.asarray(a).reshape(-1),
+                              (0, padded - size))
+                return plan.place(flat, dp_sh)
+
+            return jax.tree_util.tree_map(put, host_tree)
+        if plan is not None:
+            sh = self._exec.arg_dict[name]._data.sharding
+            return jax.tree_util.tree_map(
+                lambda a: plan.place(np.asarray(a), sh), host_tree)
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.asarray(a), dev), host_tree)
+
+    def _opt_state_bytes_per_device(self):
+        """Bytes of optimizer state resident on ONE device — the
+        executor.opt_state_bytes gauge (ZeRO's whole point is shrinking
+        this ~dp×)."""
+        import jax
+
+        total = 0
+        for tree in (self._fused_state or {}).values():
+            for leaf in jax.tree_util.tree_leaves(tree):
+                sh = getattr(leaf, "sharding", None)
+                if sh is not None and hasattr(sh, "shard_shape"):
+                    shard = sh.shard_shape(tuple(leaf.shape))
+                    total += int(np.prod(shard, dtype=np.int64)
+                                 * leaf.dtype.itemsize)
+                else:
+                    total += int(leaf.nbytes)
+        return total
 
     def _lr_device(self, dev):
         """Device scalar for the current base lr, cached per value."""
@@ -786,22 +989,11 @@ class Module(BaseModule):
         import jax
         import jax.numpy as jnp
 
-        pnames = list(self._grad_param_names)
-        optimizer = self._optimizer
-        lr_mult = {n: optimizer.lr_mult.get(n, 1.0) for n in pnames}
-        wd_mult = {n: optimizer.wd_mult.get(n, 1.0) for n in pnames}
+        update = self._make_param_update()
 
         def apply_grads(params, grads, states, lr, t):
             t_f = (t + 1).astype(jnp.float32)
-            new_params = {}
-            new_states = {}
-            for n in pnames:
-                w, s = optimizer.apply(params[n], grads[n], states[n],
-                                       lr * lr_mult[n],
-                                       optimizer.wd * wd_mult[n], t_f)
-                new_params[n] = w.astype(params[n].dtype)
-                new_states[n] = jax.tree_util.tree_map(
-                    lambda new, old: new.astype(old.dtype), s, states[n])
+            new_params, new_states = update(params, grads, states, lr, t_f)
             return new_params, new_states, t + 1
 
         return jax.jit(apply_grads, donate_argnums=(0, 2, 4))
@@ -922,19 +1114,137 @@ class Module(BaseModule):
         mon.install(self._exec)
 
     # ------------------------------------------------------------------
+    _FUSED_STATES_FORMAT = "mxnet_tpu-fused-states-v1"
+
     def save_optimizer_states(self, fname):
-        """reference: module.py:543 save_optimizer_states"""
+        """reference: module.py:543 save_optimizer_states
+
+        Fused-path states are written LAYOUT-INDEPENDENTLY: every slot
+        is gathered to its full param-shaped host value (ZeRO shards
+        are all-gathered and unpadded), so a checkpoint written by a
+        sharded run loads in a replicated run and vice versa."""
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
-        else:
-            with open(fname, "wb") as fout:
+            return
+        with open(fname, "wb") as fout:
+            if self._fused_state is not None:
+                fout.write(pickle.dumps(self._fused_states_to_host()))
+            elif self._pending_fused_states is not None:
+                # loaded from a checkpoint but no step run yet (the
+                # fused programs aren't built): pass the host states
+                # through unchanged rather than writing an empty blob
+                step, states = self._pending_fused_states
+                fout.write(pickle.dumps(
+                    {"format": self._FUSED_STATES_FORMAT,
+                     "step": int(step), "states": states}))
+            else:
                 fout.write(self._updater.get_states())
+
+    def _fused_states_to_host(self):
+        """Gather the fused optimizer state into the layout-independent
+        checkpoint dict: {name: param-shaped host tree} + step count.
+        All processes of a spanning mesh call this in lockstep (the
+        sharded leaves ride the bulk-synchronous gather_global)."""
+        import jax
+
+        from ..ndarray import gather_global
+
+        states = {}
+        for n, tree in self._fused_state.items():
+            shape = tuple(self._exec.arg_dict[n].shape)
+            size = self._zero_meta[n][0] if self._zero else None
+
+            def to_host(a, shape=shape, size=size):
+                h = gather_global(a)
+                if size is not None:  # ZeRO: drop pad, restore shape
+                    h = h[:size].reshape(shape)
+                return h
+
+            states[n] = jax.tree_util.tree_map(to_host, tree)
+        return {"format": self._FUSED_STATES_FORMAT,
+                "step": int(self._step_count), "states": states}
+
+    def _restore_fused_states(self, step, states_by_name):
+        """Install checkpointed optimizer states (host, param-shaped)
+        into this module — immediately when the fused programs exist,
+        else deferred to _ensure_fused_built, which re-scatters them
+        into whatever layout (ZeRO-sharded or replicated) this run
+        uses."""
+        self._step_count = int(step)
+        self._optimizer._index_update_count[0] = self._step_count
+        self._optimizer.num_update = max(self._optimizer.num_update,
+                                         self._step_count)
+        if self._fused_step is None:
+            self._pending_fused_states = (self._step_count,
+                                          dict(states_by_name))
+            return
+        import jax
+        import jax.numpy as jnp
+
+        dev = self._context[0].jax_device()
+        for n in self._grad_param_names:
+            if n in states_by_name:
+                self._fused_state[n] = self._place_state_tree(
+                    n, states_by_name[n], dev)
+        if self._mesh_plan is not None:
+            self._fused_t = self._mesh_plan.place(
+                np.int32(self._step_count), self._mesh_plan.replicated())
+        else:
+            with jax.default_device(dev):
+                self._fused_t = jnp.int32(self._step_count)
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
-        else:
-            with open(fname, "rb") as f:
-                self._updater.set_states(f.read())
+            return
+        with open(fname, "rb") as f:
+            blob = f.read()
+        data = pickle.loads(blob)
+        if isinstance(data, dict) and \
+                data.get("format") == self._FUSED_STATES_FORMAT:
+            # ALWAYS populate the eager Updater: even under
+            # MXNET_FUSED_STEP=1 a module can end up on the plain
+            # update path for good (monitored run, inputs_need_grad,
+            # non-loss output heads), and parking the states only in
+            # _pending_fused_states would silently restart
+            # Adam/momentum from zero there.  Keys follow model.py
+            # _update_params' convention (param_index * num_device);
+            # leaves stay host numpy — jax commits them on first use,
+            # so a ZeRO run never materializes the full state on one
+            # device just for this fallback copy
+            import jax
+
+            nd_count = len(self._context)
+            name2idx = {n: i for i, n in enumerate(self._param_names)}
+            self._updater.states = {
+                name2idx[n] * nd_count:
+                    jax.tree_util.tree_map(np.asarray, tree)
+                for n, tree in data["states"].items() if n in name2idx}
+            step = int(data["step"])
+            for i in self._updater.states:
+                self._optimizer._index_update_count[i] = step
+            self._optimizer.num_update = max(
+                self._optimizer.num_update, step)
+            if self._use_fused:
+                self._restore_fused_states(step, data["states"])
+            return
+        self._updater.set_states(blob)
+        if self._use_fused and self._updater.states:
+            # legacy index-keyed blob feeding a fused run: map the
+            # keys (param_index * num_device, model.py _update_params)
+            # back to names so the fused state inherits it
+            import jax
+
+            nd_count = len(self._context)
+            idx2name = {i * nd_count: n
+                        for i, n in enumerate(self._param_names)}
+            by_name = {}
+            for i, tree in self._updater.states.items():
+                n = i if isinstance(i, str) else idx2name.get(i)
+                if n in self._param_names:
+                    by_name[n] = jax.tree_util.tree_map(
+                        lambda a: np.asarray(a), tree)
+            if by_name:
+                self._restore_fused_states(self._step_count, by_name)

@@ -202,6 +202,23 @@ def _attention_infer(attrs, in_shapes):
     return in_shapes, [tuple(q)], []
 
 
+def _check_qkv_packing(last_dim, num_heads, shape):
+    """Reject a qkv last dim that is not a positive multiple of
+    3*num_heads — shared by shape inference and the runtime op, so the
+    diagnosis is the same whichever path a bad graph reaches first
+    (and not an opaque Pallas reshape failure later).  last_dim <
+    3*num_heads also rejects d_head = 0, which a bare % 3 check would
+    wave through."""
+    if last_dim % (3 * num_heads) or last_dim < 3 * num_heads:
+        raise MXNetError(
+            f"QKVSelfAttention: qkv last dim {last_dim} does not pack "
+            f"3*num_heads*d_head with num_heads={num_heads} (needs a "
+            f"positive multiple of 3*{num_heads} = {3 * num_heads}); "
+            f"expected packing is (B, T, 3*num_heads*d_head) laid out "
+            f"as contiguous thirds [q | k | v], each third holding all "
+            f"heads' d_head lanes (got shape {tuple(shape)})")
+
+
 def _qkv_infer(attrs, in_shapes):
     (s,) = in_shapes
     if s is None:
@@ -211,15 +228,7 @@ def _qkv_infer(attrs, in_shapes):
         raise MXNetError(
             f"QKVSelfAttention wants a 3-D qkv (B, T, 3*num_heads*d_head); "
             f"got {s}")
-    if s[2] % (3 * H):
-        # catch the packing mismatch here, with the expected layout in
-        # the message — not as an opaque Pallas reshape failure later
-        raise MXNetError(
-            f"QKVSelfAttention: qkv last dim {s[2]} is not divisible by "
-            f"3*num_heads = 3*{H} = {3 * H}; expected packing is "
-            f"(B, T, 3*num_heads*d_head) laid out as contiguous thirds "
-            f"[q | k | v], each third holding all heads' d_head lanes "
-            f"(got shape {s})")
+    _check_qkv_packing(s[2], H, s)
     return in_shapes, [(s[0], s[1], s[2] // 3)], []
 
 
@@ -239,6 +248,7 @@ def _qkv_attention(op_ctx, attrs, inputs, aux):
     from . import pallas_kernels as pk
 
     B, T, HD3 = qkv.shape
+    _check_qkv_packing(HD3, H, qkv.shape)
     D = HD3 // (3 * H)
     if pk.enabled():
         return [pk.flash_mha_packed(qkv, H, causal=causal,

@@ -127,6 +127,17 @@ class Optimizer:
         """Pure: (new_weight, new_state). Runs under jit."""
         raise NotImplementedError
 
+    def init_state_arrays_sharded(self, weight_flat, sharding):
+        """ZeRO-1 state init: the state pytree over a FLAT dp-padded
+        weight, every leaf pinned to the 'dp'-sharded layout
+        (``MeshPlan.opt_state_sharding``) so each device allocates only
+        its 1/dp shard.  Traceable — the Module jits ONE builder over
+        every param's state so no host-side full-size buffer (and no
+        per-param compile) ever materializes."""
+        state = self.init_state_arrays(weight_flat)
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(a, sharding), state)
+
     def _preprocess(self, grad):
         grad = grad * self.rescale_grad
         if self.clip_gradient is not None:

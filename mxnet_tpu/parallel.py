@@ -146,6 +146,23 @@ class MeshPlan:
             spec[self.batch_axis] = "dp"
         return self._named(P(*spec))
 
+    def opt_state_sharding(self):
+        """Layout of ZeRO-1 optimizer state: flat (1-D) arrays
+        partitioned over 'dp' (replicated over 'tp'), so each
+        data-parallel rank stores and updates only its 1/dp slice of
+        every Adam/momentum slot (Rajbhandari et al., 2020 stage 1).
+        Params/grads are flattened and padded to ``zero_padded_size``
+        before being pinned to this sharding — see
+        Module._make_param_update."""
+        from jax.sharding import PartitionSpec as P
+
+        return self._named(P("dp"))
+
+    def zero_padded_size(self, size: int) -> int:
+        """Smallest dp-divisible length >= ``size`` — flat params are
+        zero-padded to it so every 'dp' rank owns an equal shard."""
+        return -(-int(size) // self.dp) * self.dp
+
     def param_sharding(self, ndim: int, attr: Optional[str] = None):
         """Replicated unless a '__shard__' attr ("axis:dim") says else."""
         from jax.sharding import PartitionSpec as P

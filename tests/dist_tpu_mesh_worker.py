@@ -82,21 +82,45 @@ def train(X, y, batch_size, kvstore, seed=7):
     return {k: v.asnumpy() for k, v in args.items()}
 
 
+def tp_union_order(X, y, num_workers=2, global_batch=16):
+    """The single-process row order equivalent to the 2-process tp run:
+    each global batch is [proc0's rows ‖ proc1's rows] along 'dp', and
+    proc p's rows are X[p::num_workers] — i.e. window g reorders to
+    evens-then-odds."""
+    idx = []
+    for g in range(len(X) // global_batch):
+        base = g * global_batch
+        for p in range(num_workers):
+            idx.extend(range(base + p, base + global_batch, num_workers))
+    return X[idx], y[idx]
+
+
 def train_tp(rank):
     """dp=4 × tp=2 over the SAME process-spanning mesh: each host owns
     two whole dp rows (tp pairs stay within a host — the layout
     MeshPlan.batch_scale enforces); the fc1 weight is tensor-sharded
-    over 'tp'."""
+    over 'tp'.
+
+    ``rank=None`` = the single-process ground truth: same dp=4×tp=2
+    mesh over 8 local devices, fed the union data in the staged global
+    order (``tp_union_order``) at the full global batch.
+    test_dist.py::test_launch_module_fit_tpu_mesh compares final
+    weights between the two, the way the dp=8 phase does."""
     import jax
 
     from mxnet_tpu import parallel
 
-    mx.random.seed(11 + rank)  # broadcast must still unify
+    mx.random.seed(11 + (rank or 0))  # broadcast must still unify
     rng = np.random.RandomState(9)
     X = rng.randn(32, 16).astype(np.float32)
     y = rng.randint(0, 4, size=32).astype(np.float32)
-    Xs, ys = X[rank::2], y[rank::2]
-    it = mx.io.NDArrayIter(Xs, ys, batch_size=8, shuffle=False,
+    if rank is None:
+        Xs, ys = tp_union_order(X, y)
+        batch = 16
+    else:
+        Xs, ys = X[rank::2], y[rank::2]
+        batch = 8
+    it = mx.io.NDArrayIter(Xs, ys, batch_size=batch, shuffle=False,
                            label_name="softmax_label")
     data = mx.sym.Variable("data")
     net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1",
@@ -122,15 +146,21 @@ def train_tp(rank):
                 p[np.arange(len(lab)), lab], 1e-9)).mean())
             self.num_inst += 1
 
+    # no explicit rescale_grad: init_optimizer must default it to
+    # 1/GLOBAL batch (local × batch_scale) on a process-spanning mesh
+    # — this run is the regression test for that default
     mod.fit(it, num_epoch=6, optimizer="sgd",
             optimizer_params={"learning_rate": 0.1},
             initializer=mx.initializer.Xavier(), eval_metric=CE(),
             batch_end_callback=lambda p: losses.append(
                 p.eval_metric.get()[1]))
     args, _ = mod.get_params()
-    digest = sum(float(v.asnumpy().sum()) for v in args.values())
+    # gather_global, not asnumpy: fc1 weight/bias are genuinely
+    # tp-sharded across the mesh; every rank calls this in lockstep
+    params = {k: mx.nd.gather_global(v) for k, v in args.items()}
+    digest = sum(float(v.sum()) for v in params.values())
     assert losses[-1] < losses[0], (losses[0], losses[-1])
-    return digest
+    return digest, params
 
 
 def main():
@@ -152,8 +182,10 @@ def main():
     print(f"worker {rank}/{nw}: module fit tpu mesh OK", flush=True)
 
     # phase 2: dp=4 x tp=2 (tensor parallelism within each host) over
-    # the same process-spanning mesh
-    digest = train_tp(rank)
+    # the same process-spanning mesh; full weights saved so the test
+    # can compare against the single-process dp=4×tp=2 ground truth
+    digest, tp_params = train_tp(rank)
+    np.savez(out_path + f".tp.rank{rank}", **tp_params)
     print(f"worker {rank}/{nw}: tp mesh OK digest={digest:.6f}",
           flush=True)
 

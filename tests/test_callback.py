@@ -1,0 +1,180 @@
+"""Behavior-parity tests pinning the from-spec rewrites of
+Speedometer/ProgressBar (callback.py) and the lr schedulers'
+edge semantics (round-5 copy findings: the previous bodies were
+line-for-line reference copies)."""
+
+import logging
+import math
+from collections import namedtuple
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.callback import ProgressBar, Speedometer
+from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+
+Param = namedtuple("Param", ["epoch", "nbatch", "eval_metric"])
+
+
+class _FakeMetric:
+    def __init__(self):
+        self.resets = 0
+
+    def get_name_value(self):
+        return [("acc", 0.5), ("ce", 1.25)]
+
+    def reset(self):
+        self.resets += 1
+
+
+def test_speedometer_report_cadence(caplog):
+    """First call only opens the window; reports fire on every multiple
+    of `frequent`, one line per metric, with a positive rate."""
+    m = _FakeMetric()
+    s = Speedometer(batch_size=4, frequent=2, auto_reset=True)
+    with caplog.at_level(logging.INFO):
+        for nb in range(1, 7):
+            s(Param(epoch=0, nbatch=nb, eval_metric=m))
+    msgs = [r.getMessage() for r in caplog.records if "Speed:" in r.getMessage()]
+    # nbatch 2 primes nothing (window opened at nbatch=1); reports at
+    # 2, 4, 6 → 3 reports × 2 metric lines
+    assert len(msgs) == 6, msgs
+    assert all("Epoch[0]" in m_ for m_ in msgs)
+    assert any("Train-acc=0.5" in m_ for m_ in msgs)
+    speed = float(msgs[0].split("Speed: ")[1].split(" ")[0])
+    assert speed > 0
+    assert m.resets == 3  # auto_reset fires once per report
+
+
+def test_speedometer_no_autoreset_and_epoch_rewind(caplog):
+    m = _FakeMetric()
+    s = Speedometer(batch_size=4, frequent=2, auto_reset=False)
+    with caplog.at_level(logging.INFO):
+        for nb in (1, 2, 3, 4):
+            s(Param(epoch=0, nbatch=nb, eval_metric=m))
+        n_epoch0 = len(caplog.records)
+        # epoch boundary: counter rewinds; the first call must only
+        # re-open the window (no report even on a multiple of frequent)
+        s(Param(epoch=1, nbatch=2, eval_metric=m))
+        assert len(caplog.records) == n_epoch0
+        s(Param(epoch=1, nbatch=4, eval_metric=m))
+        assert len(caplog.records) == n_epoch0 + 2
+    assert m.resets == 0
+
+
+def test_speedometer_no_metric(caplog):
+    s = Speedometer(batch_size=8, frequent=1)
+    with caplog.at_level(logging.INFO):
+        s(Param(epoch=2, nbatch=1, eval_metric=None))  # primes only
+        s(Param(epoch=2, nbatch=2, eval_metric=None))
+    msgs = [r.getMessage() for r in caplog.records]
+    assert len(msgs) == 1 and "Epoch[2]" in msgs[0] and "Speed:" in msgs[0]
+
+
+def test_progress_bar_frames(capsys):
+    bar = ProgressBar(total=4, length=8)
+    bar(Param(epoch=0, nbatch=2, eval_metric=None))
+    out = capsys.readouterr().out
+    assert out == "[====----] 50%\r"
+    bar(Param(epoch=0, nbatch=3, eval_metric=None))
+    assert capsys.readouterr().out == "[======--] 75%\r"
+    bar(Param(epoch=0, nbatch=4, eval_metric=None))
+    assert capsys.readouterr().out == "[========] 100%\r"
+
+
+def test_progress_bar_ceil_percent(capsys):
+    bar = ProgressBar(total=3, length=6)
+    bar(Param(epoch=0, nbatch=1, eval_metric=None))
+    out = capsys.readouterr().out
+    # 1/3 → 33.33% ceils to 34, bar rounds to 2 of 6 cells
+    assert out == "[==----] 34%\r"
+    assert math.ceil(100.0 * 1 / 3.0) == 34
+
+
+# -- lr scheduler parity (the reference's exact decay boundaries) -------
+
+
+def test_factor_scheduler_boundaries():
+    s = FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    assert s(10) == 1.0          # boundary itself does not decay
+    assert s(11) == 0.5          # first update past it does
+    assert s.count == 10
+    assert s(20) == 0.5
+    assert s(21) == 0.25
+
+
+def test_factor_scheduler_lazy_catchup():
+    s = FactorScheduler(step=10, factor=0.5)
+    s.base_lr = 1.0
+    # one call far ahead applies every overdue decay at once
+    assert s(31) == 0.125
+    assert s.count == 30
+
+
+def test_factor_scheduler_floor():
+    s = FactorScheduler(step=1, factor=0.1, stop_factor_lr=0.05)
+    s.base_lr = 1.0
+    assert abs(s(2) - 0.1) < 1e-12
+    assert s(3) == 0.05          # 0.01 < floor → clamps
+    assert s(50) == 0.05         # and stays clamped
+
+
+def test_factor_scheduler_validation():
+    import pytest
+
+    with pytest.raises(ValueError):
+        FactorScheduler(step=0)
+    with pytest.raises(ValueError):
+        FactorScheduler(step=5, factor=1.5)
+
+
+def test_multifactor_scheduler_boundaries():
+    m = MultiFactorScheduler(step=[5, 15], factor=0.1)
+    m.base_lr = 1.0
+    assert m(5) == 1.0           # milestone itself does not decay
+    assert abs(m(6) - 0.1) < 1e-12
+    assert m.count == 5
+    assert abs(m(15) - 0.1) < 1e-12
+    assert abs(m(16) - 0.01) < 1e-12
+    assert abs(m(1000) - 0.01) < 1e-12  # past the last milestone
+
+
+def test_multifactor_scheduler_catchup_and_validation():
+    import pytest
+
+    m = MultiFactorScheduler(step=[5, 15], factor=0.1)
+    m.base_lr = 1.0
+    assert abs(m(16) - 0.01) < 1e-12  # both milestones in one call
+    with pytest.raises(ValueError):
+        MultiFactorScheduler(step=[5, 5], factor=0.1)
+    with pytest.raises(ValueError):
+        MultiFactorScheduler(step=[0, 5], factor=0.1)
+    with pytest.raises(ValueError):
+        MultiFactorScheduler(step=[5, 15], factor=2.0)
+
+
+def test_scheduler_drives_training_lr():
+    """End-to-end: the scheduler's lr reaches the fused update (the lr
+    device-scalar cache must track scheduler changes)."""
+    sched = FactorScheduler(step=2, factor=0.5)
+    opt = mx.optimizer.create("sgd", learning_rate=0.8, lr_scheduler=sched)
+    assert sched.base_lr == 0.8
+    rng = np.random.RandomState(0)
+    X = rng.randn(48, 8).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(
+        mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                  name="fc"), name="softmax"),
+        context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.init_optimizer(optimizer=opt)
+    for b in it:
+        mod.forward_backward(b)
+        mod.update()
+    # 6 updates with step=2: decays after updates 3 and 5 → 0.8/4
+    assert abs(opt.lr_scheduler(opt.num_update) - 0.2) < 1e-12

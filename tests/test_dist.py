@@ -171,6 +171,20 @@ def test_launch_module_fit_tpu_mesh(tmp_path):
         np.testing.assert_allclose(d0[k], single[k], rtol=1e-4, atol=1e-5,
                                    err_msg=f"mesh != single for {k}")
 
+    # tp phase ground truth: the 2-process dp=4×tp=2 weights must also
+    # equal a single-process dp=4×tp=2 run on the union data in the
+    # staged global order — rank agreement alone can't catch a
+    # consistently-wrong sharded matmul
+    _, tp_single = W.train_tp(None)
+    t0 = dict(np.load(out + ".tp.rank0.npz"))
+    t1 = dict(np.load(out + ".tp.rank1.npz"))
+    assert set(t0) == set(tp_single)
+    for k in tp_single:
+        np.testing.assert_allclose(t0[k], t1[k], rtol=1e-6, atol=1e-7,
+                                   err_msg=f"tp worker disagreement on {k}")
+        np.testing.assert_allclose(t0[k], tp_single[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=f"tp mesh != single for {k}")
+
 
 def test_launch_module_fit_dist_sync_on_server(tmp_path):
     """Server-side sync updates (MXNET_KVSTORE_SYNC_ON_SERVER=1): the

@@ -116,3 +116,16 @@ def test_softmax_ce_loss_ignore_label():
     g = gd.asnumpy()
     np.testing.assert_allclose(g[0, 2], 0.0, atol=1e-8)
     assert np.abs(g[0, 3]).max() > 0
+
+
+def test_qkv_packing_validation():
+    """_qkv_infer rejects a last dim that is a multiple of 3 but not of
+    3*num_heads (the weaker % 3 check waved these through), and a
+    zero-width qkv; the message names the expected packing."""
+    sym = mx.sym.QKVSelfAttention(mx.sym.Variable("qkv"), num_heads=4)
+    with pytest.raises(mx.base.MXNetError, match=r"3\*num_heads\*d_head"):
+        sym.infer_shape(qkv=(2, 8, 6))  # 6 % 3 == 0 but 6 % 12 != 0
+    with pytest.raises(mx.base.MXNetError, match="positive multiple"):
+        sym.infer_shape(qkv=(2, 8, 0))  # d_head = 0
+    _, out, _ = sym.infer_shape(qkv=(2, 8, 24))
+    assert tuple(out[0]) == (2, 8, 8)

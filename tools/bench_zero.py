@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""ZeRO-1 sharded-optimizer benchmark: per-device optimizer-state bytes
+and update-segment device time, sharded (MXNET_ZERO=1) vs replicated
+(MXNET_ZERO=0), on the same dp mesh.
+
+Prints ONE JSON line (the `bench.py` convention):
+
+  {"metric": "zero_opt_state_ratio", "value": N, "unit": "x",
+   "dp": N, "param_count": N, "opt_state_bytes_rep": N,
+   "opt_state_bytes_zero": N, "update_ms_rep": N, "update_ms_zero": N,
+   "update_speedup": N, "weights_match": true, ...}
+
+Methodology (PERF.md appendix "ZeRO-1 sharded optimizer"):
+- Model: 3-layer MLP, ~BENCH_ZERO_HIDDEN^2*2 params, Adam (2 fp32
+  slots per param — the SURVEY §7(d) state-traffic regime).
+- opt_state bytes = Module._opt_state_bytes_per_device(): the bytes of
+  Adam m/v resident on ONE device, computed from each slot's actual
+  `sharding.shard_shape` (the `executor.opt_state_bytes` gauge).
+  Sharded mode must show ~1/dp of replicated (padding slack aside).
+- update-segment time = the module's own jitted optimizer-only program
+  (`_apply_grads` — the exact update code the fused step inlines,
+  including ZeRO's reduce-scatter + all-gather), ping-ponged
+  BENCH_ZERO_ITERS times feeding each call's donated outputs back in,
+  wall-clocked around a final block_until_ready.  First call
+  (compile) excluded.
+- weights_match: N fused training steps under each mode from identical
+  init must agree to 1e-5 (fp-reassociation of the gradient reduction
+  is the only permitted difference).
+
+Env knobs: BENCH_ZERO_HIDDEN (default 512), BENCH_ZERO_ITERS (default
+20), BENCH_ZERO_STEPS (default 4), BENCH_ZERO_DEVICES (default 8,
+virtual CPU devices when no accelerator platform is configured).
+"""
+
+import json
+import os
+import sys
+import time
+
+_DEV = int(os.environ.get("BENCH_ZERO_DEVICES", "8"))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count={_DEV}").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+HIDDEN = int(os.environ.get("BENCH_ZERO_HIDDEN", "512"))
+ITERS = int(os.environ.get("BENCH_ZERO_ITERS", "20"))
+STEPS = int(os.environ.get("BENCH_ZERO_STEPS", "4"))
+BATCH = 32
+
+
+def _sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=HIDDEN, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=HIDDEN, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc3")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _train(zero):
+    """Fused-train STEPS steps on a dp mesh; returns the module (fused
+    state built) and its final weights."""
+    os.environ["MXNET_ZERO"] = "1" if zero else "0"
+    mx.random.seed(11)
+    rng = np.random.RandomState(5)
+    X = rng.randn(BATCH * STEPS, HIDDEN).astype(np.float32)
+    y = rng.randint(0, 16, size=BATCH * STEPS).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = mx.mod.Module(_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(mx.initializer.Uniform(0.05))
+    mod.init_optimizer(kvstore="tpu", optimizer="adam",
+                       optimizer_params={"learning_rate": 1e-3})
+    for b in it:
+        mod.forward_backward(b)
+        mod.update()
+    args, _ = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in args.items()}
+
+
+def _time_update_segment(mod):
+    """Wall-clock the module's jitted optimizer-only program (the exact
+    update segment of the fused step) over ITERS ping-ponged calls."""
+    import jax
+
+    dev = mod._context[0].jax_device()
+    pnames = mod._grad_param_names
+    params = {n: mod._exec.arg_dict[n]._data for n in pnames}
+    plan = mod._mesh_plan
+    grads = {n: plan.place(np.full(tuple(mod._exec.arg_dict[n].shape), 1e-3,
+                                   np.float32), plan.replicated())
+             for n in pnames}
+    states, t = mod._fused_state, mod._fused_t
+    lr = mod._lr_device(dev)
+    # compile + settle (excluded from timing)
+    params, states, t = mod._apply_grads(params, grads, states, lr, t)
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, states, t = mod._apply_grads(params, grads, states, lr, t)
+    jax.block_until_ready(params)
+    return (time.perf_counter() - t0) * 1e3 / ITERS
+
+
+def main():
+    results = {}
+    for zero in (False, True):
+        mod, weights = _train(zero)
+        key = "zero" if zero else "rep"
+        assert mod._zero == zero, (mod._zero, zero)
+        results[f"opt_state_bytes_{key}"] = mod._opt_state_bytes_per_device()
+        results[f"update_ms_{key}"] = round(_time_update_segment(mod), 4)
+        results[f"weights_{key}"] = weights
+    rep, zer = results.pop("weights_rep"), results.pop("weights_zero")
+    match = all(np.allclose(rep[k], zer[k], rtol=1e-5, atol=1e-6)
+                for k in rep)
+    import jax
+
+    out = {
+        "metric": "zero_opt_state_ratio",
+        "value": round(results["opt_state_bytes_rep"]
+                       / max(1, results["opt_state_bytes_zero"]), 3),
+        "unit": "x",
+        "dp": len(jax.devices()),
+        "param_count": int(sum(np.prod(v.shape) for v in rep.values())),
+        "update_speedup": round(results["update_ms_rep"]
+                                / max(1e-9, results["update_ms_zero"]), 3),
+        "weights_match": bool(match),
+        "hidden": HIDDEN, "iters": ITERS, "steps": STEPS,
+        **results,
+    }
+    print(json.dumps(out))
+    if not match:
+        raise SystemExit("sharded and replicated training diverged")
+
+
+if __name__ == "__main__":
+    main()
