@@ -43,6 +43,8 @@ from . import comm
 from . import kvstore
 from . import kvstore as kv
 from . import model
+from . import checkpoint
+from .checkpoint import CheckpointManager
 from . import module
 from . import module as mod
 from . import operator

@@ -1,0 +1,818 @@
+"""Fault-tolerant asynchronous checkpointing.
+
+The durability layer of the framework: :class:`CheckpointManager`
+snapshots the COMPLETE training state — parameters, optimizer state
+(layout-independent, via the fused-state gather or the eager/kvstore
+updater), lr-scheduler position, the global PRNG key, and the data-
+iterator position — to stable host/device memory synchronously, then
+serializes, checksums and writes the shard files on a background
+thread so ``fit.step`` keeps running.
+
+Commit protocol (Orbax-style commit marker, sharded like ZeRO-family
+checkpointers)::
+
+    <dir>/ckpt-<step>.tmp/            every rank writes here
+        shard-<rank>.bin              pickled snapshot of this rank
+        shard-<rank>.ok               {"sha256","bytes"} — durable marker
+        COMMIT                        rank 0, after ALL .ok files exist
+    <dir>/ckpt-<step>/                rank 0: atomic dir rename
+
+A checkpoint exists only once the COMMIT marker is inside a renamed
+(non-``.tmp``) directory; a crash at ANY earlier point leaves a torn
+``.tmp`` directory that restore ignores.  The all-shards gate is the
+kvstore barrier in synchronous mode (each rank's shard is durable
+before the barrier releases rank 0's commit) and the ``.ok``-file scan
+in async mode (the background writers' file-based barrier).  Restore
+scans newest-committed-first, verifies every checksum, and falls back
+to the previous checkpoint on corruption.
+
+Fault-tolerance hooks: a SIGTERM handler triggers an emergency
+synchronous checkpoint (preemption), ``Module.fit(...,
+checkpoint=manager, resume='auto')`` resumes epoch/batch/step/RNG/
+iterator exactly, and ``MXNET_CKPT_EVERY_N_STEPS`` / ``keep`` drive
+cadence and garbage collection.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import queue
+import shutil
+import signal
+import threading
+import time
+from collections import namedtuple
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import profiler as _prof
+from .base import MXNetError
+
+__all__ = [
+    "CheckpointManager", "atomic_save", "atomic_write_bytes",
+    "list_checkpoints", "read_commit", "verify_checkpoint", "load_shard",
+    "CkptInfo", "FORMAT",
+]
+
+FORMAT = "mxnet_tpu-ckpt-v1"
+_COMMIT_FILE = "COMMIT"
+_DIR_PREFIX = "ckpt-"
+_TMP_SUFFIX = ".tmp"
+
+CkptInfo = namedtuple("CkptInfo", ["step", "path", "committed"])
+
+
+# ---------------------------------------------------------------------------
+# atomic file primitives (shared with model.save_checkpoint)
+# ---------------------------------------------------------------------------
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # e.g. a filesystem that refuses O_RDONLY on dirs
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_save(path: str, writer) -> None:
+    """Crash-safe file write: ``writer(tmp_path)`` produces the file,
+    which is fsynced and atomically renamed over ``path`` — a crash at
+    any point leaves either the old file or the new one, never a
+    truncated hybrid."""
+    tmp = f"{path}.part.{os.getpid()}"
+    try:
+        writer(tmp)
+        _fsync_file(tmp)
+        os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    def write(tmp):
+        with open(tmp, "wb") as f:
+            f.write(data)
+
+    atomic_save(path, write)
+
+
+# ---------------------------------------------------------------------------
+# env plumbing — every declared checkpoint var fails LOUDLY when invalid
+# ---------------------------------------------------------------------------
+
+def _env(name: str, override=None, minimum=None):
+    """Resolve a declared checkpoint env var (explicit override wins),
+    raising a clear MXNetError on an unparsable or out-of-range value
+    instead of silently checkpointing on a wrong cadence."""
+    from . import config
+
+    var = config.describe(name)
+    if override is not None:
+        val = override
+    else:
+        raw = os.environ.get(name)
+        if raw is None:
+            return var.default
+        try:
+            val = var.dtype(raw)
+        except (TypeError, ValueError):
+            raise MXNetError(
+                f"invalid {name}={raw!r}: expected {var.dtype.__name__}. "
+                f"{var.doc.splitlines()[0]}")
+    if minimum is not None and val is not None and val < minimum:
+        raise MXNetError(f"invalid {name}={val!r}: must be >= {minimum}")
+    return val
+
+
+_CRASH_POINTS = ("mid_shard", "before_commit")
+
+
+class _CrashInjector:
+    """Fault-injection hook for the crash tests (MXNET_CKPT_CRASH).
+
+    ``mid_shard[:n]``      — die (exit 9) halfway through writing this
+                             rank's shard bytes of the n-th save
+    ``before_commit[:n]``  — die after the all-shards barrier/marker of
+                             the n-th save, before rank 0's COMMIT
+
+    Spec is validated at manager construction so a typo fails loudly
+    instead of silently never firing.
+    """
+
+    def __init__(self, spec: Optional[str]):
+        self.point = None
+        self.nth = 1
+        if not spec:
+            return
+        parts = spec.split(":")
+        if parts[0] not in _CRASH_POINTS or len(parts) > 2 or \
+                (len(parts) == 2 and not parts[1].isdigit()):
+            raise MXNetError(
+                f"invalid MXNET_CKPT_CRASH={spec!r}: expected one of "
+                f"{_CRASH_POINTS} with an optional ':<nth-save>' suffix")
+        self.point = parts[0]
+        if len(parts) == 2:
+            self.nth = int(parts[1])
+
+    def armed(self, point: str, save_count: int) -> bool:
+        return self.point == point and save_count == self.nth
+
+    def fire(self):
+        logging.warning("[ckpt] MXNET_CKPT_CRASH=%s firing: exiting hard",
+                        self.point)
+        os._exit(9)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint directory scanning / verification (shared with ckpt_inspect)
+# ---------------------------------------------------------------------------
+
+def _parse_step(name: str) -> Optional[int]:
+    if not name.startswith(_DIR_PREFIX):
+        return None
+    stem = name[len(_DIR_PREFIX):]
+    if stem.endswith(_TMP_SUFFIX):
+        stem = stem[:-len(_TMP_SUFFIX)]
+    return int(stem) if stem.isdigit() else None
+
+
+def list_checkpoints(directory: str) -> List[CkptInfo]:
+    """All checkpoint directories under ``directory``, step-ascending.
+    ``committed`` is True only for renamed dirs containing COMMIT."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        step = _parse_step(name)
+        path = os.path.join(directory, name)
+        if step is None or not os.path.isdir(path):
+            continue
+        committed = (not name.endswith(_TMP_SUFFIX)
+                     and os.path.isfile(os.path.join(path, _COMMIT_FILE)))
+        out.append(CkptInfo(step, path, committed))
+    out.sort(key=lambda i: (i.step, i.committed))
+    return out
+
+
+def read_commit(path: str) -> Dict[str, Any]:
+    """Parse and sanity-check a checkpoint's COMMIT manifest."""
+    marker = os.path.join(path, _COMMIT_FILE)
+    if not os.path.isfile(marker):
+        raise MXNetError(f"checkpoint {path!r} has no COMMIT marker "
+                         "(torn/uncommitted)")
+    try:
+        with open(marker) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise MXNetError(f"corrupt COMMIT marker in {path!r}: {exc}")
+    if manifest.get("format") != FORMAT or "shards" not in manifest:
+        raise MXNetError(f"unrecognized COMMIT manifest in {path!r}")
+    return manifest
+
+
+def _shard_name(rank: int) -> str:
+    return f"shard-{rank:05d}.bin"
+
+
+def verify_checkpoint(path: str) -> List[str]:
+    """Checksum every shard against the COMMIT manifest; returns the
+    list of problems (empty == bit-clean)."""
+    problems: List[str] = []
+    try:
+        manifest = read_commit(path)
+    except MXNetError as exc:
+        return [str(exc)]
+    for rank_key, meta in sorted(manifest["shards"].items()):
+        shard = os.path.join(path, _shard_name(int(rank_key)))
+        try:
+            with open(shard, "rb") as f:
+                blob = f.read()
+        except OSError as exc:
+            problems.append(f"missing shard {shard!r}: {exc}")
+            continue
+        if len(blob) != meta["bytes"]:
+            problems.append(f"shard {shard!r}: size {len(blob)} != "
+                            f"manifest {meta['bytes']}")
+        elif hashlib.sha256(blob).hexdigest() != meta["sha256"]:
+            problems.append(f"shard {shard!r}: sha256 mismatch")
+    return problems
+
+
+def load_shard(path: str, rank: int) -> Dict[str, Any]:
+    """Verify + unpickle one rank's shard of a committed checkpoint.
+    If the world size shrank, rank falls back to shard 0 (every shard
+    carries the full parameters; only the iterator position is
+    rank-local)."""
+    manifest = read_commit(path)
+    shards = manifest["shards"]
+    key = f"{rank:05d}"
+    if key not in shards:
+        fallback = sorted(shards)[0]
+        logging.warning("[ckpt] %s has no shard for rank %d "
+                        "(saved with %d shards); loading shard %s",
+                        path, rank, len(shards), fallback)
+        key = fallback
+    shard = os.path.join(path, _shard_name(int(key)))
+    try:
+        with open(shard, "rb") as f:
+            blob = f.read()
+    except OSError as exc:
+        raise MXNetError(f"missing shard in {path!r}: {exc}")
+    if hashlib.sha256(blob).hexdigest() != shards[key]["sha256"]:
+        raise MXNetError(f"checksum mismatch in {shard!r} "
+                         "(corrupt checkpoint)")
+    state = pickle.loads(blob)
+    if not isinstance(state, dict) or state.get("format") != FORMAT:
+        raise MXNetError(f"unrecognized snapshot format in {shard!r}")
+    return state
+
+
+# ---------------------------------------------------------------------------
+# the manager
+# ---------------------------------------------------------------------------
+
+def _to_host_tree(obj):
+    """Materialize every device array in a nested snapshot to host
+    numpy (runs on the background writer — the D2H transfers and the
+    full serialization stay off the training thread)."""
+    import jax
+
+    from .ndarray import NDArray
+
+    if isinstance(obj, dict):
+        return {k: _to_host_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_host_tree(v) for v in obj]
+        return type(obj)(t) if isinstance(obj, tuple) else t
+    if isinstance(obj, NDArray):
+        return obj.asnumpy()
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    return obj
+
+
+class CheckpointManager:
+    """Snapshots complete training state; writes + commits off-thread.
+
+    Parameters (each falls back to its declared MXNET env var):
+
+    - ``directory``: checkpoint root (shared across ranks).
+    - ``keep``: newest committed checkpoints retained (older GC'd).
+    - ``every_n_steps``: save cadence inside ``fit`` (0 = only manual/
+      emergency saves).
+    - ``async_save``: True (default) snapshots synchronously but
+      serializes/writes/commits on a background thread; False blocks
+      through the commit (using the kvstore barrier as the all-shards
+      gate when one is attached).
+    - ``kvstore``: rank/num_workers/barrier provider; discovered from
+      the module at ``fit`` time when not given.
+    """
+
+    def __init__(self, directory: str, keep: Optional[int] = None,
+                 every_n_steps: Optional[int] = None,
+                 async_save: Optional[bool] = None,
+                 rank: Optional[int] = None,
+                 num_shards: Optional[int] = None,
+                 kvstore=None, logger: Optional[logging.Logger] = None):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.keep = int(_env("MXNET_CKPT_KEEP", keep, minimum=1))
+        self.every_n_steps = int(
+            _env("MXNET_CKPT_EVERY_N_STEPS", every_n_steps, minimum=0))
+        a = _env("MXNET_CKPT_ASYNC", async_save)
+        self.async_save = bool(int(a) if not isinstance(a, bool) else a)
+        self.commit_timeout = float(
+            _env("MXNET_CKPT_COMMIT_TIMEOUT", None, minimum=0.0))
+        self._crash = _CrashInjector(os.environ.get("MXNET_CKPT_CRASH"))
+        self.logger = logger or logging.getLogger("mxnet_tpu.checkpoint")
+
+        self._kv = kvstore
+        self._rank_override = rank
+        self._shards_override = num_shards
+        self._module = None
+        self._train_iter = None
+        self._last = {"epoch": 0, "nbatch": -1}
+        self._step = 0          # update count; checkpoint id
+        self._save_count = 0    # saves attempted (crash-injection index)
+        self._in_step = False
+        self._preempted = False
+        self._signum = None
+        self._prev_handler = None
+        self._iter_warned = False
+
+        self._queue: queue.Queue = queue.Queue(maxsize=4)
+        self._writer: Optional[threading.Thread] = None
+        self._writer_lock = threading.Lock()
+        self.last_error: Optional[BaseException] = None
+
+    # -- topology ------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        if self._rank_override is not None:
+            return int(self._rank_override)
+        if self._kv is not None:
+            return int(self._kv.rank)
+        from .base import get_env
+
+        return get_env("MXNET_WORKER_ID", 0, int)
+
+    @property
+    def num_shards(self) -> int:
+        if self._shards_override is not None:
+            return int(self._shards_override)
+        if self._kv is not None:
+            return int(self._kv.num_workers)
+        from .base import get_env
+
+        return get_env("MXNET_NUM_WORKERS", 1, int)
+
+    # -- fit integration ----------------------------------------------
+    def attach(self, module, train_iter=None) -> None:
+        """Remember the live module/iterator (emergency saves, cadence
+        saves, and kvstore discovery all use the attached refs)."""
+        self._module = module
+        if train_iter is not None:
+            self._train_iter = train_iter
+        kv = getattr(module, "_kvstore", None)
+        if kv is not None:
+            self._kv = kv
+
+    def step_begin(self) -> None:
+        self._in_step = True
+
+    def step_end(self, module, epoch: int, nbatch: int,
+                 train_iter=None) -> None:
+        """Per-update hook: advances the step counter, applies the
+        MXNET_CKPT_EVERY_N_STEPS cadence, and finishes a deferred
+        preemption save at this safe point."""
+        self._in_step = False
+        self.attach(module, train_iter)
+        self._step += 1
+        self._last = {"epoch": int(epoch), "nbatch": int(nbatch)}
+        if self.every_n_steps and self._step % self.every_n_steps == 0:
+            self.save(epoch=epoch, nbatch=nbatch)
+        if self._preempted:
+            self._emergency_exit()
+
+    # -- save ----------------------------------------------------------
+    def save(self, module=None, epoch: Optional[int] = None,
+             nbatch: Optional[int] = None, train_iter=None,
+             step: Optional[int] = None, sync: Optional[bool] = None,
+             reason: str = "periodic") -> None:
+        """Checkpoint now.  Blocks only for the in-memory snapshot when
+        async (the serialize/checksum/write/commit pipeline runs on the
+        background writer); blocks through the distributed commit when
+        ``sync``.  Called at the same step on every rank."""
+        module = module if module is not None else self._module
+        if module is None:
+            raise MXNetError("CheckpointManager.save: no module attached "
+                             "(pass one or call attach/fit first)")
+        train_iter = train_iter if train_iter is not None else self._train_iter
+        if sync is None:
+            sync = not self.async_save
+        t0 = time.perf_counter()
+        snap = self._snapshot(
+            module,
+            self._last["epoch"] if epoch is None else int(epoch),
+            self._last["nbatch"] if nbatch is None else int(nbatch),
+            train_iter, self._step if step is None else int(step), reason)
+        self._save_count += 1
+        snap["_save_count"] = self._save_count
+        accepted = True
+        if sync:
+            if self._writer is not None:
+                self.flush()  # keep shard writes ordered per rank
+            # an emergency (preemption) save must not block on the kv
+            # barrier: a peer may be dead or at a different step, and a
+            # barrier hang during shutdown would forfeit the save — the
+            # commit gate falls back to the bounded .ok-file scan
+            self._process(snap, use_kv_barrier=(reason != "preempt"))
+        else:
+            self._ensure_writer()
+            try:
+                # backpressure, not silent loss: when the writer still
+                # has a backlog, wait for a slot (the wait is part of
+                # ckpt.blocking_ms — visible, not hidden).  Only a
+                # storage HANG (commit_timeout) drops the save.
+                self._queue.put(snap, timeout=self.commit_timeout)
+            except queue.Full:
+                accepted = False
+                _prof.inc_counter("ckpt.skipped")
+                self.logger.warning(
+                    "[ckpt] writer stuck for %.0fs; skipping save at "
+                    "step %d (storage hang?)", self.commit_timeout,
+                    snap["step"])
+        blocking_ms = (time.perf_counter() - t0) * 1e3
+        _prof.observe("ckpt.blocking_ms", blocking_ms)
+        if accepted:
+            _prof.inc_counter("ckpt.saves")
+
+    def _snapshot(self, module, epoch, nbatch, train_iter, step, reason):
+        """Synchronous part: pin the training state into buffers that
+        survive the next (donating) step.  Fully-addressable arrays stay
+        ON DEVICE (a cheap device-side copy; D2H runs on the writer);
+        cross-host-sharded arrays must gather collectively NOW, while
+        every rank is at the same program point."""
+        from .ndarray import NDArray, gather_global
+
+        def stable(v):
+            d = v._data if isinstance(v, NDArray) else v
+            if getattr(d, "is_fully_addressable", True):
+                return v  # get_params already copied; writer does D2H
+            return gather_global(d)
+
+        arg_params, aux_params = module.get_params()
+        snap: Dict[str, Any] = {
+            "format": FORMAT,
+            "step": int(step),
+            "epoch": int(epoch),
+            "nbatch": int(nbatch),
+            "rank": self.rank,
+            "num_shards": self.num_shards,
+            "reason": reason,
+            "wall_time": time.time(),
+            "arg_params": {k: stable(v) for k, v in arg_params.items()},
+            "aux_params": {k: stable(v) for k, v in aux_params.items()},
+            "optimizer": self._snapshot_optimizer(module),
+            "rng": _rng_get_state(),
+            "iter_state": self._snapshot_iter(train_iter),
+        }
+        return snap
+
+    def _snapshot_optimizer(self, module):
+        if not getattr(module, "optimizer_initialized", False):
+            return None  # params-only snapshot (e.g. pre-init manual save)
+        to_host = getattr(module, "_optimizer_states_to_host", None)
+        if to_host is not None:
+            return to_host(lazy=True)
+        saver = getattr(module, "save_optimizer_states", None)
+        if saver is None:
+            return None
+        # generic module: round-trip through its own states file format
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(suffix=".states")
+        os.close(fd)
+        try:
+            saver(tmp)
+            with open(tmp, "rb") as f:
+                return {"kind": "blob", "blob": f.read()}
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _snapshot_iter(self, train_iter):
+        if train_iter is None:
+            return None
+        state_dict = getattr(train_iter, "state_dict", None)
+        if state_dict is None:
+            return None
+        try:
+            return state_dict()
+        except MXNetError as exc:
+            if not self._iter_warned:
+                self._iter_warned = True
+                self.logger.warning(
+                    "[ckpt] data iterator position not checkpointed (%s); "
+                    "resume will restart the epoch's data", exc)
+            return None
+
+    # -- background writer --------------------------------------------
+    def _ensure_writer(self):
+        with self._writer_lock:
+            if self._writer is None or not self._writer.is_alive():
+                self._writer = threading.Thread(
+                    target=self._writer_loop, name="ckpt-writer", daemon=True)
+                self._writer.start()
+
+    def _writer_loop(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                self._queue.task_done()
+                return
+            try:
+                self._process(job, use_kv_barrier=False)
+            except BaseException as exc:  # keep the writer alive
+                self.last_error = exc
+                _prof.inc_counter("ckpt.failures")
+                self.logger.exception(
+                    "[ckpt] background save at step %s failed",
+                    job.get("step"))
+            finally:
+                self._queue.task_done()
+
+    def flush(self) -> None:
+        """Block until every queued async save is written + committed."""
+        if self._writer is not None:
+            self._queue.join()
+
+    def close(self) -> None:
+        """Drain pending saves and stop the writer thread."""
+        with self._writer_lock:
+            writer, self._writer = self._writer, None
+        if writer is not None and writer.is_alive():
+            self._queue.put(None)
+            writer.join()
+
+    # -- write + commit ------------------------------------------------
+    def _process(self, snap, use_kv_barrier: bool) -> None:
+        t0 = time.perf_counter()
+        step = snap["step"]
+        save_count = snap.pop("_save_count", self._save_count)
+        num_shards = snap["num_shards"]
+        rank = snap["rank"]
+        final = os.path.join(self.dir, f"{_DIR_PREFIX}{step:012d}")
+        tmp = final + _TMP_SUFFIX
+        if os.path.isdir(final):
+            self.logger.info("[ckpt] step %d already committed; skipping",
+                             step)
+            return
+        os.makedirs(tmp, exist_ok=True)
+
+        blob = pickle.dumps(_to_host_tree(snap), protocol=4)
+        sha = hashlib.sha256(blob).hexdigest()
+        shard_path = os.path.join(tmp, _shard_name(rank))
+        if self._crash.armed("mid_shard", save_count):
+            # fault injection: a torn, un-.ok'd shard under its final name
+            with open(shard_path, "wb") as f:
+                f.write(blob[:max(1, len(blob) // 2)])
+                f.flush()
+                os.fsync(f.fileno())
+            self._crash.fire()
+        atomic_write_bytes(shard_path, blob)
+        atomic_write_bytes(
+            os.path.join(tmp, f"shard-{rank:05d}.ok"),
+            json.dumps({"sha256": sha, "bytes": len(blob),
+                        "step": step}).encode())
+        _prof.inc_counter("ckpt.bytes", float(len(blob)))
+
+        barrier = getattr(self._kv, "barrier", None)
+        if use_kv_barrier and barrier is not None:
+            # synchronous mode: the kvstore barrier is the all-shards
+            # gate — every rank's shard is durable before it releases
+            barrier()
+        if self._crash.armed("before_commit", save_count):
+            # fault injection: all shards durable, COMMIT never written
+            self._crash.fire()
+        if rank == 0:
+            committed = self._commit(
+                step, tmp, final, num_shards,
+                wait=not (use_kv_barrier and barrier is not None))
+            if committed:
+                self._gc()
+        if use_kv_barrier and barrier is not None:
+            barrier()  # every rank returns with the commit visible
+        _prof.observe("ckpt.save_ms", (time.perf_counter() - t0) * 1e3)
+        _prof.set_gauge("ckpt.last_step", float(step))
+
+    def _commit(self, step, tmp, final, num_shards, wait: bool) -> bool:
+        """Rank 0: gate on every shard's .ok marker, write the COMMIT
+        manifest, and atomically rename the directory into existence."""
+        deadline = time.monotonic() + self.commit_timeout
+        shards: Dict[str, Any] = {}
+        missing = list(range(num_shards))
+        while missing:
+            for r in list(missing):
+                ok = os.path.join(tmp, f"shard-{r:05d}.ok")
+                try:
+                    with open(ok) as f:
+                        shards[f"{r:05d}"] = json.load(f)
+                    missing.remove(r)
+                except (OSError, ValueError):
+                    continue
+            if not missing:
+                break
+            if not wait or time.monotonic() > deadline:
+                _prof.inc_counter("ckpt.commit_timeouts")
+                self.logger.error(
+                    "[ckpt] step %d: shards %s never arrived; leaving "
+                    "uncommitted %s", step, missing, tmp)
+                return False
+            time.sleep(0.05)
+        manifest = {"format": FORMAT, "step": step,
+                    "num_shards": num_shards, "shards": shards,
+                    "wall_time": time.time()}
+        atomic_write_bytes(os.path.join(tmp, _COMMIT_FILE),
+                           json.dumps(manifest, indent=1).encode())
+        _fsync_dir(tmp)
+        os.rename(tmp, final)
+        _fsync_dir(self.dir)
+        self.logger.info("[ckpt] committed step %d -> %s", step, final)
+        return True
+
+    def _gc(self) -> None:
+        """Keep the newest ``keep`` committed checkpoints; drop older
+        ones and any torn .tmp attempt older than the newest commit."""
+        infos = list_checkpoints(self.dir)
+        committed = [i for i in infos if i.committed]
+        if not committed:
+            return
+        newest = committed[-1].step
+        for info in committed[:-self.keep] if len(committed) > self.keep \
+                else []:
+            shutil.rmtree(info.path, ignore_errors=True)
+            self.logger.info("[ckpt] GC: removed %s", info.path)
+        for info in infos:
+            if not info.committed and info.step < newest:
+                shutil.rmtree(info.path, ignore_errors=True)
+                self.logger.info("[ckpt] GC: removed torn %s", info.path)
+
+    # -- restore -------------------------------------------------------
+    def load_latest(self) -> Optional[Dict[str, Any]]:
+        """Newest committed, checksum-clean snapshot for this rank (or
+        None).  A corrupt newest checkpoint logs a warning and falls
+        back to the previous committed one."""
+        for info in reversed(list_checkpoints(self.dir)):
+            if not info.committed:
+                continue
+            try:
+                state = load_shard(info.path, self.rank)
+            except MXNetError as exc:
+                self.logger.warning(
+                    "[ckpt] %s unusable (%s); falling back to the "
+                    "previous committed checkpoint", info.path, exc)
+                continue
+            self._step = int(state["step"])
+            self._save_count = 0
+            if self.rank == 0:
+                # retire torn attempts from the run we're superseding so
+                # a retried step never mixes shards from two attempts
+                for torn in list_checkpoints(self.dir):
+                    if not torn.committed and torn.path.endswith(_TMP_SUFFIX):
+                        shutil.rmtree(torn.path, ignore_errors=True)
+            self.logger.info("[ckpt] resuming from %s (step %d, epoch %d, "
+                             "batch %d)", info.path, state["step"],
+                             state["epoch"], state["nbatch"])
+            return state
+        return None
+
+    def restore_training_state(self, module, state: Dict[str, Any],
+                               train_iter=None) -> None:
+        """Install everything except the parameters (those go through
+        ``init_params``): optimizer state, PRNG key, iterator position.
+        Call after ``init_optimizer``."""
+        self.attach(module, train_iter)
+        payload = state.get("optimizer")
+        if payload:
+            self._install_optimizer(module, payload)
+        if state.get("rng") is not None:
+            _rng_set_state(state["rng"])
+        it_state = state.get("iter_state")
+        if it_state is not None and train_iter is not None:
+            try:
+                train_iter.set_state(it_state)
+            except MXNetError as exc:
+                self.logger.warning(
+                    "[ckpt] could not restore data-iterator position "
+                    "(%s); the epoch's data restarts", exc)
+        self._step = int(state["step"])
+
+    def _install_optimizer(self, module, payload) -> None:
+        install = getattr(module, "_install_optimizer_states", None)
+        if install is not None and payload.get("kind") != "blob":
+            install(payload)
+            return
+        loader = getattr(module, "load_optimizer_states", None)
+        if loader is None:
+            raise MXNetError("module cannot restore optimizer states")
+        import tempfile
+
+        if payload.get("kind") == "blob":
+            blob = payload["blob"]
+        elif payload.get("kind") == "fused":
+            # round-trip through the module's own fused states format
+            from .module.module import Module as _Module
+
+            blob = pickle.dumps({"format": _Module._FUSED_STATES_FORMAT,
+                                 "step": payload["step"],
+                                 "states": payload["states"]})
+        else:
+            blob = payload.get("blob", b"")
+        if not blob:
+            return
+        fd, tmp = tempfile.mkstemp(suffix=".states")
+        os.close(fd)
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            loader(tmp)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    # -- preemption ----------------------------------------------------
+    def install_signal_handler(self, signum: int = signal.SIGTERM) -> bool:
+        """Emergency checkpoint on ``signum`` (preemption notice): saves
+        synchronously at the next safe point — immediately if between
+        steps, at the step boundary if one is running — then re-raises
+        the signal so the process still dies with the expected status.
+        Returns False when not on the main thread (signals can only be
+        installed there)."""
+        try:
+            self._prev_handler = signal.signal(signum, self._on_signal)
+        except ValueError:
+            return False
+        self._signum = signum
+        return True
+
+    def _on_signal(self, signum, frame):
+        self.logger.warning("[ckpt] signal %d: emergency checkpoint "
+                            "requested", signum)
+        self._preempted = True
+        if not self._in_step:
+            self._emergency_exit()
+
+    def _emergency_exit(self):
+        signum = self._signum or signal.SIGTERM
+        try:
+            if self._module is not None and self._step > 0:
+                self.save(sync=True, reason="preempt")
+            self.close()
+        finally:
+            try:
+                signal.signal(signum, self._prev_handler or signal.SIG_DFL)
+            except ValueError:
+                pass
+            os.kill(os.getpid(), signum)
+
+
+def _rng_get_state():
+    from . import random as _random
+
+    return _random.get_state()
+
+
+def _rng_set_state(state):
+    from . import random as _random
+
+    _random.set_state(state)

@@ -171,6 +171,41 @@ register_env(
     "kvstore_dist_server.h:136-219 design); 0 (default) keeps the "
     "replicated-updater allgather-sum path.")
 register_env(
+    "MXNET_CKPT_DIR", None, str,
+    "Checkpoint root directory.  When set, Module.fit creates a "
+    "CheckpointManager automatically (cadence from "
+    "MXNET_CKPT_EVERY_N_STEPS); pass fit(resume='auto') to restore the "
+    "newest committed checkpoint.  Shared across ranks of a dist run.")
+register_env(
+    "MXNET_CKPT_EVERY_N_STEPS", 0, int,
+    "Checkpoint every N optimizer steps inside Module.fit (0 = only "
+    "manual and SIGTERM-emergency saves).  Invalid values raise at "
+    "CheckpointManager construction.")
+register_env(
+    "MXNET_CKPT_KEEP", 5, int,
+    "Newest committed checkpoints retained; older ones (and torn .tmp "
+    "attempts they supersede) are garbage-collected by rank 0 after "
+    "each commit.")
+register_env(
+    "MXNET_CKPT_ASYNC", 1, int,
+    "1 (default): checkpoint saves snapshot training state "
+    "synchronously (device-side copies; cross-host shards gather) and "
+    "serialize/checksum/write/commit on a background thread so "
+    "fit.step keeps running.  0: block through the distributed commit, "
+    "with the kvstore barrier gating rank 0's COMMIT marker.")
+register_env(
+    "MXNET_CKPT_COMMIT_TIMEOUT", 300.0, float,
+    "Seconds rank 0's committer waits for every rank's shard-OK marker "
+    "before abandoning the checkpoint as uncommitted (async mode's "
+    "file-based barrier).  The torn .tmp directory is left for the "
+    "next GC; training continues.")
+register_env(
+    "MXNET_CKPT_CRASH", None, str,
+    "Fault-injection hook for the crash tests: 'mid_shard[:n]' dies "
+    "halfway through writing this rank's shard of the n-th save; "
+    "'before_commit[:n]' dies after the all-shards barrier, before "
+    "rank 0's COMMIT.  Unknown values raise.  NEVER set in production.")
+register_env(
     "MXNET_TEST_DEVICE", None, str,
     "Device the test utilities bind to (test_utils.default_context; "
     "the reference's MXNET_TEST_DEVICE).  Unset: the ambient current "

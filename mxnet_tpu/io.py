@@ -116,6 +116,27 @@ class DataIter:
     def getpad(self):
         raise NotImplementedError
 
+    # -- checkpointing --------------------------------------------------
+    def state_dict(self):
+        """Serializable snapshot of the iterator position (cursor +
+        shuffle order) for batch-exact resume.  Implemented by the core
+        iterators; others raise so a checkpointing caller can degrade
+        gracefully instead of silently resuming at the wrong batch."""
+        raise MXNetError(f"{type(self).__name__} does not support "
+                         "checkpointing (state_dict)")
+
+    def set_state(self, state, rewind=False):
+        """Restore a :meth:`state_dict` snapshot: the next ``next()``
+        returns exactly the batch the snapshotted iterator would have
+        returned, including the (seeded) shuffle order.
+
+        ``rewind=True`` restores the epoch-level state (shuffle order,
+        RNG) but positions at the EPOCH START — how a wrapping
+        :class:`PrefetchingIter` re-produces the epoch before skipping
+        to the consumed position."""
+        raise MXNetError(f"{type(self).__name__} does not support "
+                         "checkpointing (set_state)")
+
 
 def _init_data(data, allow_empty, default_name):
     """Normalize input data to list of (name, numpy) (reference: io.py:460)."""
@@ -154,11 +175,13 @@ class NDArrayIter(DataIter):
         self.num_data = self.data[0][1].shape[0]
         assert self.num_data >= batch_size, "batch_size needs to be smaller than data size"
 
+        # the shuffle is a PERMUTATION VIEW, not a data reorder: keeping
+        # the rows in place and indexing through _order lets state_dict/
+        # set_state capture and restore the exact shuffle order for
+        # batch-exact checkpoint resume
+        self._order = np.arange(self.num_data)
         if shuffle:
-            idx = np.arange(self.num_data)
-            np.random.shuffle(idx)
-            self.data = [(k, v[idx]) for k, v in self.data]
-            self.label = [(k, v[idx]) for k, v in self.label]
+            np.random.shuffle(self._order)
         self.idx = np.arange(self.num_data)
 
         # batching
@@ -201,12 +224,36 @@ class NDArrayIter(DataIter):
 
     def _getdata(self, data_source):
         assert self.cursor < self.num_data, "DataIter needs reset."
-        if self.cursor + self.batch_size <= self.num_data:
-            return [array(x[1][self.cursor:self.cursor + self.batch_size]) for x in data_source]
-        # padded last batch: wrap around
-        pad = self.batch_size - self.num_data + self.cursor
-        return [array(np.concatenate([x[1][self.cursor:], x[1][:pad]], axis=0))
-                for x in data_source]
+        end = self.cursor + self.batch_size
+        if end <= self.num_data:
+            sel = self._order[self.cursor:end]
+        else:
+            # padded last batch: wrap around
+            sel = np.concatenate([self._order[self.cursor:self.num_data],
+                                  self._order[:end - self.num_data]])
+        return [array(x[1][sel]) for x in data_source]
+
+    def state_dict(self):
+        return {"kind": "NDArrayIter", "cursor": int(self.cursor),
+                "order": self._order.copy(), "num_data": int(self.num_data),
+                "batch_size": int(self.batch_size)}
+
+    def set_state(self, state, rewind=False):
+        if state.get("kind") != "NDArrayIter":
+            raise MXNetError(f"NDArrayIter.set_state: snapshot is for "
+                             f"{state.get('kind')!r}")
+        if int(state["num_data"]) != self.num_data or \
+                int(state["batch_size"]) != self.batch_size:
+            raise MXNetError(
+                "NDArrayIter.set_state: snapshot shape mismatch "
+                f"(saved num_data={state['num_data']}/batch_size="
+                f"{state['batch_size']}, this iterator has "
+                f"{self.num_data}/{self.batch_size})")
+        order = np.asarray(state["order"])
+        if order.shape != self._order.shape:
+            raise MXNetError("NDArrayIter.set_state: corrupt shuffle order")
+        self._order = order.copy()
+        self.cursor = -self.batch_size if rewind else int(state["cursor"])
 
     def getdata(self):
         return self._getdata(self.data)
@@ -262,6 +309,16 @@ class ResizeIter(DataIter):
     def getpad(self):
         return self.current_batch.pad
 
+    def state_dict(self):
+        return {"kind": "ResizeIter", "cur": int(self.cur),
+                "inner": self.data_iter.state_dict()}
+
+    def set_state(self, state, rewind=False):
+        if state.get("kind") != "ResizeIter":
+            raise MXNetError("ResizeIter.set_state: wrong snapshot kind")
+        self.data_iter.set_state(state["inner"], rewind=rewind)
+        self.cur = 0 if rewind else int(state["cur"])
+
 
 class PrefetchingIter(DataIter):
     """Background prefetch + device staging over one or more iterators.
@@ -283,8 +340,6 @@ class PrefetchingIter(DataIter):
     def __init__(self, iters, rename_data=None, rename_label=None,
                  ctx=None, prefetch_depth=2):
         super().__init__()
-        import queue as _queue
-
         if not isinstance(iters, list):
             iters = [iters]
         assert len(iters) > 0
@@ -295,10 +350,21 @@ class PrefetchingIter(DataIter):
         self._ctx = ctx
         self.batch_size = self.provide_data[0][1][0]
         self.current_batch = None
-        self._alive = True
+        self._prefetch_depth = prefetch_depth
         self._gen = 0
         self._epoch_done = False
-        self._queues = [_queue.Queue(maxsize=prefetch_depth)
+        self._consumed = 0  # batches delivered this epoch (checkpointing)
+        self._state_lock = threading.Lock()  # vs. worker epoch resets
+        self._start_workers()
+
+    def _start_workers(self):
+        """(Re)create the queues and producer threads; the workers
+        produce from the source iterators' CURRENT position (first epoch
+        runs without a reset)."""
+        import queue as _queue
+
+        self._alive = True
+        self._queues = [_queue.Queue(maxsize=self._prefetch_depth)
                         for _ in range(self.n_iter)]
         self._epoch_go = [threading.Event() for _ in range(self.n_iter)]
         for e in self._epoch_go:
@@ -336,7 +402,8 @@ class PrefetchingIter(DataIter):
             gen = self._gen
             try:
                 if not first:
-                    it.reset()  # the worker owns its iterator
+                    with self._state_lock:  # vs. state_dict order capture
+                        it.reset()  # the worker owns its iterator
                 first = False
                 while self._alive and self._gen == gen:
                     try:
@@ -351,19 +418,30 @@ class PrefetchingIter(DataIter):
                 # consumer has seen the error
 
     def close(self):
-        """Stop the worker threads and drop queued batches."""
+        """Stop the worker threads and drop queued batches.  Loops the
+        drain+join so a producer blocked on a full queue (or mid-batch)
+        reliably reaches an exit check — set_state rebuilds the workers
+        afterwards and two producers must never share a source
+        iterator."""
+        import time as _time
+
         self._alive = False
         self._gen += 1
-        for q in self._queues:
-            while not q.empty():
-                try:
-                    q.get_nowait()
-                except Exception:
-                    break
-        for e in self._epoch_go:
-            e.set()
-        for t in self._threads:
-            t.join(timeout=1.0)
+        threads = getattr(self, "_threads", [])
+        deadline = _time.time() + 5.0
+        while any(t.is_alive() for t in threads):
+            for q in self._queues:
+                while not q.empty():
+                    try:
+                        q.get_nowait()
+                    except Exception:
+                        break
+            for e in self._epoch_go:
+                e.set()
+            for t in threads:
+                t.join(timeout=0.05)
+            if _time.time() > deadline:
+                break
 
     def __del__(self):
         try:
@@ -390,6 +468,7 @@ class PrefetchingIter(DataIter):
     def reset(self):
         self._gen += 1
         self._epoch_done = False
+        self._consumed = 0
         # unblock workers stuck on a full queue, discard stale items
         for q in self._queues:
             while not q.empty():
@@ -399,6 +478,41 @@ class PrefetchingIter(DataIter):
                     break
         for e in self._epoch_go:
             e.set()
+
+    def state_dict(self):
+        """Consumer-side position: batches DELIVERED this epoch plus the
+        source iterators' epoch-level state (shuffle order).  Prefetched-
+        but-undelivered batches are deliberately not part of the state —
+        resume re-produces the epoch and skips ``consumed`` batches, so
+        the next delivered batch is exactly the next unconsumed one."""
+        with self._state_lock:
+            inner = [it.state_dict() for it in self.iters]
+        return {"kind": "PrefetchingIter", "consumed": int(self._consumed),
+                "inner": inner}
+
+    def set_state(self, state, rewind=False):
+        if state.get("kind") != "PrefetchingIter":
+            raise MXNetError("PrefetchingIter.set_state: wrong snapshot kind")
+        if len(state["inner"]) != self.n_iter:
+            raise MXNetError("PrefetchingIter.set_state: iterator count "
+                             "mismatch")
+        # stop the producers before touching the source iterators, then
+        # rebuild them and re-produce the epoch from the start under the
+        # restored shuffle order (rewind=True), discarding the batches
+        # the checkpointed run had already consumed.  The skip
+        # re-decodes those batches once — the price of not having to
+        # reconstruct iterator-specific producer-vs-consumer cursor
+        # offsets.
+        self.close()
+        for it, s in zip(self.iters, state["inner"]):
+            it.set_state(s, rewind=True)
+        self._epoch_done = False
+        self._consumed = 0
+        self._start_workers()
+        for _ in range(0 if rewind else int(state["consumed"])):
+            if not self.iter_next():
+                raise MXNetError("PrefetchingIter.set_state: snapshot "
+                                 "position beyond the epoch end")
 
     def _pop(self, i):
         """Next item of the current generation from queue i (skips stale)."""
@@ -431,6 +545,7 @@ class PrefetchingIter(DataIter):
             sum([b.data for b in items], []),
             sum([(b.label or []) for b in items], []),
             pad=items[0].pad, index=items[0].index)
+        self._consumed += 1
         return True
 
     def next(self):

@@ -244,6 +244,32 @@ class ImageRecordIter(DataIter):
         self._cursor = 0
         self._seen_epoch_end = False
 
+    def state_dict(self):
+        return {"kind": "ImageRecordIter", "cursor": int(self._cursor),
+                "order": self._order.copy(), "epoch": int(self._epoch),
+                "seen_epoch_end": bool(self._seen_epoch_end),
+                "rng": self._rng.get_state(), "seed": self._seed,
+                "num_data": int(self.num_data)}
+
+    def set_state(self, state, rewind=False):
+        if state.get("kind") != "ImageRecordIter":
+            raise MXNetError("ImageRecordIter.set_state: wrong snapshot "
+                             "kind")
+        if int(state["num_data"]) != self.num_data:
+            raise MXNetError(
+                "ImageRecordIter.set_state: snapshot has num_data="
+                f"{state['num_data']}, this iterator has {self.num_data} "
+                "(different record file or sharding?)")
+        self._order = np.asarray(state["order"]).copy()
+        self._cursor = 0 if rewind else int(state["cursor"])
+        self._epoch = int(state["epoch"])
+        self._seen_epoch_end = (False if rewind
+                                else bool(state["seen_epoch_end"]))
+        self._rng.set_state(state["rng"])
+        # the per-sample augmentation stream is keyed on (seed, epoch,
+        # offset) — restore the seed so augmentations replay too
+        self._seed = state["seed"]
+
     def iter_next(self):
         return self._cursor < self.num_data and not self._seen_epoch_end
 

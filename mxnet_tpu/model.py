@@ -121,20 +121,52 @@ def _update_params(param_arrays, grad_arrays, updater, num_device, kvstore=None)
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     """Save symbol json + params (reference: model.py save_checkpoint;
-    format: prefix-symbol.json + prefix-%04d.params)."""
+    format: prefix-symbol.json + prefix-%04d.params).
+
+    Both files are written crash-safely (tmp file + fsync +
+    ``os.replace``): a kill at any point leaves either the previous
+    checkpoint or the new one on disk, never a truncated hybrid."""
+    from .checkpoint import atomic_save
+
     if symbol is not None:
-        symbol.save(f"{prefix}-symbol.json")
+        atomic_save(f"{prefix}-symbol.json", symbol.save)
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    atomic_save(param_name, lambda tmp: nd.save(tmp, save_dict))
     logging.info('Saved checkpoint to "%s"', param_name)
 
 
+def _load_checkpoint_file(path, what, loader):
+    import os
+
+    if not os.path.exists(path):
+        raise MXNetError(f"load_checkpoint: missing {what} file {path!r}")
+    try:
+        return loader(path)
+    except MXNetError:
+        raise
+    except Exception as exc:
+        raise MXNetError(
+            f"load_checkpoint: corrupt or truncated {what} file {path!r}: "
+            f"{exc}")
+
+
 def load_checkpoint(prefix, epoch):
-    """reference: model.py load_checkpoint"""
-    symbol = sym_mod.load(f"{prefix}-symbol.json")
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    """reference: model.py load_checkpoint — with errors that NAME the
+    missing or corrupt file instead of surfacing a raw parse failure."""
+    symbol = _load_checkpoint_file(f"{prefix}-symbol.json", "symbol",
+                                   sym_mod.load)
+    param_path = "%s-%04d.params" % (prefix, epoch)
+
+    def load_params(path):
+        d = nd.load(path)
+        if not isinstance(d, dict):
+            raise MXNetError("params file holds a list, not a name->array "
+                             "dict")
+        return d
+
+    save_dict = _load_checkpoint_file(param_path, "params", load_params)
     arg_params = {}
     aux_params = {}
     for k, v in save_dict.items():

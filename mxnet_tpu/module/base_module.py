@@ -116,13 +116,45 @@ class BaseModule:
             eval_end_callback=None, eval_batch_end_callback=None,
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
-            begin_epoch=0, num_epoch=None, validation_metric=None, monitor=None):
-        """Train loop (reference: base_module.py:315 fit)."""
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, checkpoint=None, resume=None):
+        """Train loop (reference: base_module.py:315 fit).
+
+        Fault tolerance: pass a ``mxnet_tpu.checkpoint.CheckpointManager``
+        as ``checkpoint`` (or set ``MXNET_CKPT_DIR``) to snapshot the
+        full training state on the ``MXNET_CKPT_EVERY_N_STEPS`` cadence
+        and on SIGTERM (preemption).  ``resume='auto'`` restores the
+        newest committed checkpoint — parameters, optimizer state,
+        lr-scheduler step, RNG, and the exact epoch/batch position of
+        the data iterator — and continues as if never interrupted.
+        """
         assert num_epoch is not None, "please specify number of epochs"
+        from ..base import get_env
         from ..initializer import Uniform
 
         if initializer is None:
             initializer = Uniform(0.01)
+
+        if checkpoint is None:
+            ckpt_dir = get_env("MXNET_CKPT_DIR", None, str)
+            if ckpt_dir:
+                from ..checkpoint import CheckpointManager
+
+                checkpoint = CheckpointManager(ckpt_dir, logger=self.logger)
+        if resume not in (None, False, True, "auto", "never"):
+            raise MXNetError(f"fit: resume must be 'auto'/'never'/bool, "
+                             f"got {resume!r}")
+        ckpt_state = None
+        if resume in (True, "auto"):
+            if checkpoint is None:
+                raise MXNetError("fit(resume='auto') needs a checkpoint "
+                                 "manager (or MXNET_CKPT_DIR)")
+            ckpt_state = checkpoint.load_latest()
+            if ckpt_state is not None:
+                arg_params = ckpt_state["arg_params"]
+                aux_params = ckpt_state["aux_params"]
+                begin_epoch = ckpt_state["epoch"]
+                force_init = True
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -134,6 +166,15 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+
+        resume_nbatch = -1
+        if checkpoint is not None:
+            checkpoint.attach(self, train_data)
+            checkpoint.install_signal_handler()
+            if ckpt_state is not None:
+                checkpoint.restore_training_state(self, ckpt_state,
+                                                  train_data)
+                resume_nbatch = ckpt_state["nbatch"]
 
         if validation_metric is None:
             validation_metric = eval_metric
@@ -152,6 +193,10 @@ class BaseModule:
             # question starts from
             train_iter = iter(train_data)
             nbatch = 0
+            if epoch == begin_epoch and resume_nbatch >= 0:
+                # the restored iterator continues mid-epoch right after
+                # the checkpointed batch; keep nbatch aligned with it
+                nbatch = resume_nbatch + 1
             while True:
                 with _prof.scope("io.next", "io",
                                  args={"epoch": epoch, "step": nbatch}):
@@ -161,11 +206,16 @@ class BaseModule:
                         break
                 if monitor is not None:
                     monitor.tic()
+                if checkpoint is not None:
+                    checkpoint.step_begin()
                 with _prof.scope("fit.step", "step",
                                  args={"epoch": epoch, "step": nbatch}):
                     self.forward_backward(data_batch)
                     self.update()
                 self.update_metric(eval_metric, data_batch.label)
+                if checkpoint is not None:
+                    checkpoint.step_end(self, epoch=epoch, nbatch=nbatch,
+                                        train_iter=train_data)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -198,6 +248,9 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
 
             train_data.reset()
+        if checkpoint is not None:
+            # land queued async snapshots before the process can exit
+            checkpoint.flush()
 
     # ------------------------------------------------------------------
     # Symbol & params (reference: base_module.py:452-545)
@@ -239,10 +292,12 @@ class BaseModule:
                          force_init=force_init)
 
     def save_params(self, fname):
+        from ..checkpoint import atomic_save
+
         arg_params, aux_params = self.get_params()
         save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
         save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        atomic_save(fname, lambda tmp: nd.save(tmp, save_dict))
 
     def load_params(self, fname):
         save_dict = nd.load(fname)

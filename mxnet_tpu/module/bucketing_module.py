@@ -216,6 +216,16 @@ class BucketingModule(BaseModule):
         for mod in self._buckets.values():
             mod.install_monitor(mon)
 
+    def _optimizer_states_to_host(self, lazy=False):
+        """Checkpoint hook: the active bucket owns the live (adopted)
+        optimizer state — see Module._adopt_fused_state."""
+        assert self.binded and self.optimizer_initialized
+        return self._curr_module._optimizer_states_to_host(lazy=lazy)
+
+    def _install_optimizer_states(self, payload):
+        assert self.binded and self.optimizer_initialized
+        self._curr_module._install_optimizer_states(payload)
+
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         """Save the default bucket's symbol + the shared params."""
         self._buckets[self._default_bucket_key]._symbol.save(

@@ -137,6 +137,8 @@ class Module(BaseModule):
         # optimizer states loaded from a checkpoint before the fused
         # programs were built: host trees, placed at _ensure_fused_built
         self._pending_fused_states = None
+        # checkpointed per-run PRNG base key, restored the same way
+        self._pending_fused_key = None
         self._pending_batch = None
         self._step_count = 0
         self._flushed_backward = False
@@ -817,22 +819,29 @@ class Module(BaseModule):
         # device-resident step counter + base PRNG key: donated and
         # returned by the step so steady state does zero scalar
         # host→device transfers.  On a mesh they live replicated.
+        # a checkpointed run resumes with ITS base key (bit-identical
+        # per-step dropout masks); otherwise draw a fresh one
+        restored_key = self._pending_fused_key
+        self._pending_fused_key = None
         if self._mesh_plan is not None:
             plan = self._mesh_plan
             rep = plan.replicated()
-            key = _random.next_key()  # raw uint32 (2,) threefry key
-            if plan.spans_processes:
+            key = (np.asarray(restored_key) if restored_key is not None
+                   else _random.next_key())  # raw uint32 (2,) threefry key
+            if plan.spans_processes and restored_key is None:
                 # one PRNG stream for the ONE global program: rank 0's
                 # key wins (identical dropout masks on every host)
                 from jax.experimental import multihost_utils
                 key = np.asarray(multihost_utils.broadcast_one_to_all(
                     np.asarray(key)))
             self._fused_t = plan.place(np.int32(self._step_count), rep)
-            self._fused_key = plan.place(key, rep)
+            self._fused_key = plan.place(np.asarray(key), rep)
         else:
             with jax.default_device(dev):
                 self._fused_t = jnp.int32(self._step_count)
-            self._fused_key = jax.device_put(_random.next_key(), dev)
+            self._fused_key = jax.device_put(
+                np.asarray(restored_key) if restored_key is not None
+                else _random.next_key(), dev)
         self._lr_cache = {}
 
     def _init_zero_mode(self):
@@ -1127,26 +1136,36 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
             return
-        with open(fname, "wb") as fout:
-            if self._fused_state is not None:
-                fout.write(pickle.dumps(self._fused_states_to_host()))
-            elif self._pending_fused_states is not None:
-                # loaded from a checkpoint but no step run yet (the
-                # fused programs aren't built): pass the host states
-                # through unchanged rather than writing an empty blob
-                step, states = self._pending_fused_states
-                fout.write(pickle.dumps(
-                    {"format": self._FUSED_STATES_FORMAT,
-                     "step": int(step), "states": states}))
-            else:
-                fout.write(self._updater.get_states())
+        from ..checkpoint import atomic_write_bytes
 
-    def _fused_states_to_host(self):
+        if self._fused_state is not None:
+            blob = pickle.dumps(self._fused_states_to_host())
+        elif self._pending_fused_states is not None:
+            # loaded from a checkpoint but no step run yet (the
+            # fused programs aren't built): pass the host states
+            # through unchanged rather than writing an empty blob
+            step, states = self._pending_fused_states
+            blob = pickle.dumps(
+                {"format": self._FUSED_STATES_FORMAT,
+                 "step": int(step), "states": states})
+        else:
+            blob = self._updater.get_states()
+        atomic_write_bytes(fname, blob)
+
+    def _fused_states_to_host(self, lazy=False):
         """Gather the fused optimizer state into the layout-independent
         checkpoint dict: {name: param-shaped host tree} + step count.
         All processes of a spanning mesh call this in lockstep (the
-        sharded leaves ride the bulk-synchronous gather_global)."""
+        sharded leaves ride the bulk-synchronous gather_global).
+
+        ``lazy``: fully-addressable leaves come back as DEVICE copies
+        (cheap, safe against the next step's donation) instead of host
+        numpy — the async checkpointer defers the D2H transfer to its
+        background writer so the training thread barely blocks.  Cross-
+        host-sharded leaves always gather to host NOW (the collective
+        must run with every rank at the same program point)."""
         import jax
+        import jax.numpy as jnp
 
         from ..ndarray import gather_global
 
@@ -1156,7 +1175,10 @@ class Module(BaseModule):
             size = self._zero_meta[n][0] if self._zero else None
 
             def to_host(a, shape=shape, size=size):
-                h = gather_global(a)
+                if lazy and getattr(a, "is_fully_addressable", True):
+                    h = jnp.array(a, copy=True)
+                else:
+                    h = gather_global(a)
                 if size is not None:  # ZeRO: drop pad, restore shape
                     h = h[:size].reshape(shape)
                 return h
@@ -1194,6 +1216,118 @@ class Module(BaseModule):
             with jax.default_device(dev):
                 self._fused_t = jnp.int32(self._step_count)
 
+    def _install_host_states(self, step, states_by_name):
+        """Install layout-independent host optimizer states (the
+        fused-checkpoint dict) into this module, whatever update path it
+        ends up on.
+
+        ALWAYS populates the eager Updater: even under
+        MXNET_FUSED_STEP=1 a module can end up on the plain update path
+        for good (monitored run, inputs_need_grad, non-loss output
+        heads), and parking the states only in _pending_fused_states
+        would silently restart Adam/momentum from zero there.  Keys
+        follow model.py _update_params' convention (param_index *
+        num_device); leaves stay host numpy — jax commits them on first
+        use, so a ZeRO run never materializes the full state on one
+        device just for this fallback copy."""
+        import jax
+
+        nd_count = len(self._context)
+        name2idx = {n: i for i, n in enumerate(self._param_names)}
+        if self._updater is not None:
+            self._updater.states = {
+                name2idx[n] * nd_count:
+                    jax.tree_util.tree_map(np.asarray, tree)
+                for n, tree in states_by_name.items() if n in name2idx}
+            for i in self._updater.states:
+                self._optimizer._index_update_count[i] = step
+        self._optimizer.num_update = max(
+            self._optimizer.num_update, step)
+        if self._use_fused:
+            self._restore_fused_states(step, states_by_name)
+
+    # -- in-memory optimizer-state snapshot/install (checkpoint.py) ----
+    def _optimizer_states_to_host(self, lazy=False):
+        """Complete, layout-independent snapshot of the optimizer state
+        for the async checkpointer — covers the fused device state, a
+        not-yet-built pending restore, the eager Updater, and the
+        kvstore-side replicated updater.  See _fused_states_to_host for
+        the ``lazy`` contract."""
+        assert self.optimizer_initialized
+        num_update = int(self._optimizer.num_update)
+        if self._update_on_kvstore:
+            kv = self._kvstore
+            quiesce = getattr(kv, "_sync_comm", None)
+            if quiesce is not None:
+                quiesce()  # the comm thread may be mid-update
+            updater = getattr(kv, "_updater", None)
+            if updater is None:
+                raise MXNetError(
+                    "cannot snapshot optimizer state: the kvstore keeps "
+                    "it server-side (MXNET_KVSTORE_SYNC_ON_SERVER)")
+            return {"kind": "updater", "blob": updater.get_states(),
+                    "num_update": num_update}
+        if self._fused_state is not None:
+            d = self._fused_states_to_host(lazy=lazy)
+            payload = {"kind": "fused", "step": d["step"],
+                       "states": d["states"], "num_update": num_update}
+            if self._fused_key is not None:
+                from ..ndarray import gather_global
+
+                payload["fused_key"] = gather_global(self._fused_key)
+            return payload
+        if self._pending_fused_states is not None:
+            step, states = self._pending_fused_states
+            payload = {"kind": "fused", "step": int(step),
+                       "states": dict(states), "num_update": num_update}
+            if self._pending_fused_key is not None:
+                payload["fused_key"] = np.asarray(self._pending_fused_key)
+            return payload
+        if self._updater is not None:
+            return {"kind": "updater", "blob": self._updater.get_states(),
+                    "num_update": num_update}
+        return {"kind": "updater", "blob": b"", "num_update": num_update}
+
+    def _install_optimizer_states(self, payload):
+        """Inverse of _optimizer_states_to_host (host-numpy payload)."""
+        assert self.optimizer_initialized
+        kind = payload.get("kind")
+        if kind == "updater":
+            blob = payload.get("blob")
+            if blob:
+                if self._update_on_kvstore:
+                    updater = getattr(self._kvstore, "_updater", None)
+                    if updater is None:
+                        raise MXNetError("cannot restore optimizer state: "
+                                         "kvstore has no local updater")
+                    updater.set_states(blob)
+                elif self._updater is not None:
+                    self._updater.set_states(blob)
+        elif kind == "fused":
+            key = payload.get("fused_key")
+            if key is not None:
+                self._pending_fused_key = np.asarray(key)
+            self._install_host_states(int(payload["step"]),
+                                      payload["states"])
+            if key is not None and self._fused_step is not None:
+                # programs already built: place the restored key now
+                import jax
+
+                if self._mesh_plan is not None:
+                    self._fused_key = self._mesh_plan.place(
+                        np.asarray(key), self._mesh_plan.replicated())
+                else:
+                    self._fused_key = jax.device_put(
+                        np.asarray(key), self._context[0].jax_device())
+                self._pending_fused_key = None
+        else:
+            raise MXNetError(
+                f"unknown optimizer-state payload kind {kind!r}")
+        nu = payload.get("num_update")
+        if nu:
+            self._optimizer.num_update = max(self._optimizer.num_update,
+                                             int(nu))
+
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
@@ -1204,31 +1338,7 @@ class Module(BaseModule):
         data = pickle.loads(blob)
         if isinstance(data, dict) and \
                 data.get("format") == self._FUSED_STATES_FORMAT:
-            # ALWAYS populate the eager Updater: even under
-            # MXNET_FUSED_STEP=1 a module can end up on the plain
-            # update path for good (monitored run, inputs_need_grad,
-            # non-loss output heads), and parking the states only in
-            # _pending_fused_states would silently restart
-            # Adam/momentum from zero there.  Keys follow model.py
-            # _update_params' convention (param_index * num_device);
-            # leaves stay host numpy — jax commits them on first use,
-            # so a ZeRO run never materializes the full state on one
-            # device just for this fallback copy
-            import jax
-
-            nd_count = len(self._context)
-            name2idx = {n: i for i, n in enumerate(self._param_names)}
-            self._updater.states = {
-                name2idx[n] * nd_count:
-                    jax.tree_util.tree_map(np.asarray, tree)
-                for n, tree in data["states"].items() if n in name2idx}
-            step = int(data["step"])
-            for i in self._updater.states:
-                self._optimizer._index_update_count[i] = step
-            self._optimizer.num_update = max(
-                self._optimizer.num_update, step)
-            if self._use_fused:
-                self._restore_fused_states(step, data["states"])
+            self._install_host_states(int(data["step"]), data["states"])
             return
         self._updater.set_states(blob)
         if self._use_fused and self._updater.states:

@@ -13,7 +13,7 @@ import threading
 
 import jax
 
-__all__ = ["seed", "next_key", "uniform", "normal"]
+__all__ = ["seed", "next_key", "get_state", "set_state", "uniform", "normal"]
 
 # process-global like the reference's MXRandomSeed (data-iterator
 # prefetch threads must see the same seeded stream)
@@ -37,6 +37,29 @@ def next_key():
             _key = jax.random.PRNGKey(_DEFAULT_SEED)
         _key, sub = jax.random.split(_key)
         return sub
+
+
+def get_state():
+    """Host snapshot of the global PRNG key for checkpointing (None if
+    the stream was never seeded or used)."""
+    import numpy as np
+
+    with _lock:
+        return None if _key is None else np.asarray(_key).copy()
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot — the stream continues
+    exactly where the checkpointed run left off."""
+    global _key
+    if state is None:
+        return
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    with _lock:
+        _key = jnp.asarray(np.asarray(state, dtype=np.uint32))
 
 
 def uniform(low=0.0, high=1.0, shape=(1,), ctx=None, out=None):

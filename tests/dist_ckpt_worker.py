@@ -1,0 +1,87 @@
+"""2-process kill-and-resume acceptance worker.
+
+Launched by ``tools/launch.py -n 2 --cpu python
+tests/dist_ckpt_worker.py <ckpt_dir> <out_prefix>``.  Each rank trains
+``Module.fit`` with ``kvstore='dist_sync'`` on its deterministic data
+shard, checkpointing SYNCHRONOUSLY every 4 steps — the kvstore barrier
+is the all-shards gate before rank 0's COMMIT, so
+``MXNET_CKPT_CRASH=before_commit:<n>`` kills every rank exactly
+between the barrier and the commit (the torn-checkpoint window the
+protocol must survive).  With ``resume='auto'`` a relaunch restores
+params + optimizer (replicated-updater momentum) + iterator position
+from the last committed checkpoint and must reproduce an uninterrupted
+run's final weights bit-for-bit (asserted by
+tests/test_dist.py::test_ckpt_kill_and_resume)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+GLOBAL_BATCH = 8
+N_SAMPLES = 64
+EPOCHS = 2
+CLASSES = 10
+
+
+def build_sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=24, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def make_data():
+    rng = np.random.RandomState(5)
+    X = rng.randn(N_SAMPLES, 16).astype(np.float32)
+    y = rng.randint(0, CLASSES, N_SAMPLES).astype(np.float32)
+    return X, y
+
+
+def shard(X, y, rank, num_workers):
+    B = GLOBAL_BATCH // num_workers
+    idx = []
+    for g in range(N_SAMPLES // GLOBAL_BATCH):
+        start = g * GLOBAL_BATCH + rank * B
+        idx.extend(range(start, start + B))
+    return X[idx], y[idx]
+
+
+def main():
+    import logging
+
+    # the test asserts on the manager's "resuming from ... step N" line
+    logging.basicConfig(level=logging.INFO)
+    ckpt_dir, out_prefix = sys.argv[1], sys.argv[2]
+    kv = mx.kv.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    X, y = make_data()
+    Xs, ys = shard(X, y, rank, nw)
+
+    mx.random.seed(7)
+    np.random.seed(7)
+    it = mx.io.NDArrayIter(Xs, ys, batch_size=GLOBAL_BATCH // nw,
+                           shuffle=False, label_name="softmax_label")
+    mod = mx.mod.Module(build_sym(), context=mx.cpu())
+    mgr = mx.CheckpointManager(ckpt_dir, every_n_steps=4, async_save=False,
+                               keep=8, kvstore=kv)
+    mod.fit(it, num_epoch=EPOCHS, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                              "rescale_grad": 1.0 / GLOBAL_BATCH},
+            kvstore=kv, initializer=mx.initializer.Xavier(rnd_type="gaussian"),
+            eval_metric="acc", checkpoint=mgr, resume="auto")
+    mgr.close()
+    args_, _ = mod.get_params()
+    np.savez(out_prefix + f".rank{rank}",
+             **{k: v.asnumpy() for k, v in args_.items()})
+    kv.barrier()
+    print(f"worker {rank}/{nw}: ckpt dist fit OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -64,6 +64,10 @@ def test_custom_symbol_forward_backward():
 
 def test_custom_in_module_training():
     """Custom op inside a trained graph: gradients flow through it."""
+    # the default Uniform initializer draws from the GLOBAL numpy
+    # stream; pin it so the outcome doesn't depend on suite order
+    np.random.seed(2)
+    mx.random.seed(2)
     rng = np.random.RandomState(2)
     X = rng.randn(80, 6).astype(np.float32)
     yv = (X.sum(axis=1) > 0).astype(np.float32)

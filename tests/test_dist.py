@@ -378,3 +378,65 @@ def test_launch_module_fit_dist_async():
                          r"digest=([\d.]+)", out)
     assert len(digests) == 2, out
     assert digests[0] == digests[1], f"worker weight digests differ: {digests}"
+
+
+def test_ckpt_kill_and_resume(tmp_path):
+    """Acceptance: kill -9 both workers of a 2-proc dist_sync fit
+    EXACTLY between the checkpoint barrier and rank 0's COMMIT, then
+    relaunch with resume='auto' — the torn checkpoint must be ignored,
+    and the resumed run's final weights (params + replicated-updater
+    momentum + iterator position all restored) must bit-match an
+    uninterrupted 2-proc run."""
+    import numpy as np
+
+    worker = os.path.join(REPO, "tests", "dist_ckpt_worker.py")
+    launch = [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+              "-n", "2", "--cpu", sys.executable, worker]
+
+    # uninterrupted reference
+    ckpt_a, out_a = str(tmp_path / "ckpt_a"), str(tmp_path / "a")
+    r = subprocess.run(launch + [ckpt_a, out_a], capture_output=True,
+                      text=True, timeout=600, cwd=REPO, env=_worker_env())
+    o = r.stdout + r.stderr
+    assert r.returncode == 0, o
+    assert "worker 0/2: ckpt dist fit OK" in o
+
+    # crash run: all ranks die after the barrier, before COMMIT, on the
+    # 2nd save (step 8 of 16)
+    ckpt_b, out_b = str(tmp_path / "ckpt_b"), str(tmp_path / "b")
+    env = _worker_env()
+    env["MXNET_CKPT_CRASH"] = "before_commit:2"
+    r = subprocess.run(launch + [ckpt_b, out_b], capture_output=True,
+                      text=True, timeout=600, cwd=REPO, env=env)
+    assert r.returncode != 0, r.stdout + r.stderr
+
+    from mxnet_tpu import checkpoint as C
+    infos = C.list_checkpoints(ckpt_b)
+    committed = [i.step for i in infos if i.committed]
+    torn = [i.step for i in infos if not i.committed]
+    assert committed == [4], infos   # step-8 attempt never committed
+    assert torn == [8], infos        # ...and its shards are all there
+    # both ranks' shards made it to durable storage before the kill —
+    # the crash window is precisely barrier -> COMMIT
+    torn_dir = [i.path for i in infos if not i.committed][0]
+    assert sorted(f for f in os.listdir(torn_dir) if f.endswith(".ok")) == \
+        ["shard-00000.ok", "shard-00001.ok"]
+    assert "COMMIT" not in os.listdir(torn_dir)
+
+    # resume run: picks the last committed checkpoint (step 4),
+    # replays, and lands on the uninterrupted run's exact weights
+    r = subprocess.run(launch + [ckpt_b, out_b], capture_output=True,
+                      text=True, timeout=600, cwd=REPO, env=_worker_env())
+    o = r.stdout + r.stderr
+    assert r.returncode == 0, o
+    assert "resuming from" in o and "step 4" in o
+
+    for rank in (0, 1):
+        ref = dict(np.load(out_a + f".rank{rank}.npz"))
+        res = dict(np.load(out_b + f".rank{rank}.npz"))
+        assert set(ref) == set(res)
+        for k in ref:
+            np.testing.assert_array_equal(
+                ref[k], res[k],
+                err_msg=f"rank{rank} {k}: resume diverged from the "
+                        "uninterrupted run")

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Benchmark checkpointing: how long does the training loop stop?
+
+Trains a real Module (so the fused optimizer state exists), then
+measures, at equal state size:
+
+- ``sync_ms``           — wall time of a fully synchronous save
+                          (snapshot + serialize + sha256 + write +
+                          fsync + commit), i.e. what the seed-era
+                          blocking ``save_checkpoint`` cost.
+- ``async_blocking_ms`` — how long ``CheckpointManager.save`` blocks
+                          the training thread in async mode (the
+                          in-memory snapshot only; the write pipeline
+                          runs on the background thread).
+- ``blocking_ratio``    — async_blocking / sync (the acceptance gate
+                          is < 0.20).
+
+Output: one JSON line, PERF.md-ready.
+
+Usage: python tools/bench_ckpt.py [--mb 64] [--iters 5] [--hidden N]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def build_module(target_mb):
+    """MLP sized so params+momentum ≈ target_mb of float32 state."""
+    # params ≈ in*h + h*h + h*out floats; momentum doubles it
+    target_floats = target_mb * (1 << 20) / 4 / 2
+    in_dim, out_dim = 256, 64
+    # solve h^2 + (in+out) h - target = 0
+    h = int((-(in_dim + out_dim)
+             + np.sqrt((in_dim + out_dim) ** 2 + 4 * target_floats)) / 2)
+    h = max(64, h)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=h, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=h, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=out_dim, name="fc3")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    batch = 8
+    mod.bind(data_shapes=[("data", (batch, in_dim))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.01,
+                                         "momentum": 0.9})
+    # a couple of real steps so the fused optimizer state is live
+    rng = np.random.RandomState(0)
+    X = rng.randn(batch * 2, in_dim).astype(np.float32)
+    y = rng.randint(0, out_dim, batch * 2).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+    for b in it:
+        mod.forward_backward(b)
+        mod.update()
+    return mod, it
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=float, default=64.0,
+                    help="target optimizer+param state size (MiB)")
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    mod, it = build_module(args.mb)
+
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        sync_ms, async_ms, save_ms, nbytes = [], [], [], 0
+        # synchronous saves (fresh manager per measurement set)
+        mgr_s = mx.CheckpointManager(os.path.join(root, "sync"),
+                                     async_save=False, keep=2)
+        mgr_s.attach(mod, it)
+        mgr_s.save(step=0)  # warm (compile/cache effects out of the timing)
+        for i in range(args.iters):
+            t0 = time.perf_counter()
+            mgr_s.save(epoch=0, nbatch=i, step=i + 1, sync=True)
+            sync_ms.append((time.perf_counter() - t0) * 1e3)
+        # async saves: measure only how long save() blocks the caller
+        mgr_a = mx.CheckpointManager(os.path.join(root, "async"),
+                                     async_save=True, keep=2)
+        mgr_a.attach(mod, it)
+        mgr_a.save(step=0)
+        mgr_a.flush()
+        for i in range(args.iters):
+            t0 = time.perf_counter()
+            mgr_a.save(epoch=0, nbatch=i, step=i + 1)
+            async_ms.append((time.perf_counter() - t0) * 1e3)
+            t1 = time.perf_counter()
+            mgr_a.flush()  # drain between iters: isolate per-save blocking
+            save_ms.append((time.perf_counter() - t1) * 1e3)
+        mgr_a.close()
+        from mxnet_tpu import checkpoint as C
+
+        infos = [x for x in C.list_checkpoints(os.path.join(root, "sync"))
+                 if x.committed]
+        nbytes = sum(os.path.getsize(os.path.join(infos[-1].path, f))
+                     for f in os.listdir(infos[-1].path))
+        sync = float(np.median(sync_ms))
+        blocking = float(np.median(async_ms))
+        out = {
+            "state_mb": round(nbytes / (1 << 20), 2),
+            "sync_ms": round(sync, 2),
+            "async_blocking_ms": round(blocking, 2),
+            "async_write_ms": round(float(np.median(save_ms)), 2),
+            "blocking_ratio": round(blocking / sync, 4),
+            "iters": args.iters,
+        }
+        print(json.dumps(out))
+        return 0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Inspect a checkpoint directory: list checkpoints, verify checksums,
+print a shard's manifest (params, optimizer payload, iterator state).
+
+Usage::
+
+    python tools/ckpt_inspect.py <ckpt_dir>             # list
+    python tools/ckpt_inspect.py <ckpt_dir> --verify    # + sha256 check
+    python tools/ckpt_inspect.py <ckpt_dir> --manifest [--step N]
+
+Exit status is non-zero when --verify finds a corrupt committed
+checkpoint, so CI can gate on it.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from mxnet_tpu import checkpoint as ckpt  # noqa: E402
+
+
+def _dir_bytes(path):
+    total = 0
+    for name in os.listdir(path):
+        try:
+            total += os.path.getsize(os.path.join(path, name))
+        except OSError:
+            pass
+    return total
+
+
+def cmd_list(args):
+    infos = ckpt.list_checkpoints(args.dir)
+    if not infos:
+        print(f"no checkpoints under {args.dir!r}")
+        return 0
+    rc = 0
+    for info in infos:
+        state = "committed" if info.committed else "torn"
+        line = (f"ckpt step={info.step} {state} "
+                f"bytes={_dir_bytes(info.path)} path={info.path}")
+        if info.committed:
+            try:
+                manifest = ckpt.read_commit(info.path)
+                line += f" shards={manifest['num_shards']}"
+            except Exception as exc:  # a mangled COMMIT is a finding,
+                line += f" COMMIT-CORRUPT ({exc})"  # not a traceback
+                rc = 1
+                print(line)
+                continue
+            if args.verify:
+                problems = ckpt.verify_checkpoint(info.path)
+                line += f" checksums={'OK' if not problems else 'CORRUPT'}"
+                if problems:
+                    rc = 1
+                    for p in problems:
+                        line += f"\n    !! {p}"
+        print(line)
+    return rc
+
+
+def cmd_manifest(args):
+    infos = [i for i in ckpt.list_checkpoints(args.dir) if i.committed]
+    if args.step is not None:
+        infos = [i for i in infos if i.step == args.step]
+    if not infos:
+        print(f"no committed checkpoint "
+              f"{'at step %d ' % args.step if args.step is not None else ''}"
+              f"under {args.dir!r}")
+        return 1
+    info = infos[-1]
+    state = ckpt.load_shard(info.path, args.rank)
+    meta = {k: state[k] for k in
+            ("step", "epoch", "nbatch", "rank", "num_shards", "reason")}
+    meta["wall_time"] = state.get("wall_time")
+    print(json.dumps({"checkpoint": info.path, "meta": meta}, indent=1,
+                     default=str))
+    print("arg_params:")
+    for name, arr in sorted(state["arg_params"].items()):
+        print(f"  {name}: shape={tuple(arr.shape)} dtype={arr.dtype}")
+    for name, arr in sorted(state.get("aux_params", {}).items()):
+        print(f"  (aux) {name}: shape={tuple(arr.shape)} dtype={arr.dtype}")
+    opt = state.get("optimizer") or {}
+    print(f"optimizer: kind={opt.get('kind')} "
+          f"num_update={opt.get('num_update')} "
+          f"slots={sorted(opt.get('states', {})) if 'states' in opt else '-'}")
+    it = state.get("iter_state")
+    if it is None:
+        print("iterator: (not checkpointed)")
+    else:
+        pos = {k: v for k, v in it.items()
+               if k in ("kind", "cursor", "consumed", "epoch", "num_data")}
+        print(f"iterator: {pos}")
+    print(f"rng: {'saved' if state.get('rng') is not None else 'none'}")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dir", help="checkpoint directory")
+    ap.add_argument("--verify", action="store_true",
+                    help="checksum every committed shard")
+    ap.add_argument("--manifest", action="store_true",
+                    help="print the newest (or --step) checkpoint's content")
+    ap.add_argument("--step", type=int, default=None)
+    ap.add_argument("--rank", type=int, default=0,
+                    help="shard to read for --manifest")
+    args = ap.parse_args(argv)
+    if args.manifest:
+        return cmd_manifest(args)
+    return cmd_list(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
