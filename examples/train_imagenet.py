@@ -35,6 +35,11 @@ def main():
     parser.add_argument("--num-batches", type=int, default=40,
                         help="benchmark batches per epoch")
     parser.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939")
+    parser.add_argument("--io-workers", type=int, default=0,
+                        help="decode-pool processes (0 = in-process)")
+    parser.add_argument("--device-augment", type=int, default=0,
+                        help="1 = uint8 wire batches + fused on-device "
+                             "crop/flip/normalize")
     add_fit_args(parser)
     parser.set_defaults(network="resnet-50", batch_size=32, num_epochs=1,
                         lr=0.1)
@@ -54,13 +59,16 @@ def main():
             path_imgrec=args.data_train, data_shape=image_shape,
             batch_size=args.batch_size, shuffle=True, rand_crop=True,
             rand_mirror=True, mean_r=mean[0], mean_g=mean[1], mean_b=mean[2],
-            preprocess_threads=8)
+            preprocess_threads=8, workers=args.io_workers,
+            device_augment=args.device_augment)
         val = None
         if args.data_val:
             val = mx.io.ImageRecordIter(
                 path_imgrec=args.data_val, data_shape=image_shape,
                 batch_size=args.batch_size, mean_r=mean[0], mean_g=mean[1],
-                mean_b=mean[2], preprocess_threads=8)
+                mean_b=mean[2], preprocess_threads=8,
+                workers=args.io_workers,
+                device_augment=args.device_augment)
 
     fit(args, net, train, val)
 

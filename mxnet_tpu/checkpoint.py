@@ -31,6 +31,20 @@ synchronous checkpoint (preemption), ``Module.fit(...,
 checkpoint=manager, resume='auto')`` resumes epoch/batch/step/RNG/
 iterator exactly, and ``MXNET_CKPT_EVERY_N_STEPS`` / ``keep`` drive
 cadence and garbage collection.
+
+Data-plane interop: the iterator position rides ``state_dict()``
+whatever the iterator's execution mode.  A pool-mode
+``ImageRecordIter(workers=N)`` snapshot is consumer-side only (cursor
++ shuffle order + epoch RNG — never in-flight ring contents), so
+restore tears the decode workers down, rebuilds them under the
+restored order, and tells them to start producing at the exact
+consumer batch — a bare iterator never re-decodes consumed batches,
+and the worker count may differ between the saving and the resuming
+run.  (A ``PrefetchingIter`` wrapper restores by replay-and-skip, so
+there consumed batches are re-decoded once.)
+Device-side augmentation (``device_augment=1``) replays bit-exactly
+because its randomness derives from the checkpointed per-step PRNG
+``(key, t)`` pair inside the fused step, not from host state.
 """
 
 from __future__ import annotations

@@ -171,6 +171,29 @@ register_env(
     "kvstore_dist_server.h:136-219 design); 0 (default) keeps the "
     "replicated-updater allgather-sum path.")
 register_env(
+    "MXNET_IO_WORKERS", 0, int,
+    "Decode-pool size for ImageRecordIter(workers=None): N > 0 fans "
+    "JPEG decode out to N forked worker processes writing a zero-copy "
+    "shared-memory batch ring (mxnet_tpu/io_pool.py); 0 (default) "
+    "keeps the single-process path.  ImageRecordIter(workers='auto') "
+    "sizes the pool min(cpu_count, 8) when this is unset.  Garbage "
+    "values raise at iterator construction.")
+register_env(
+    "MXNET_IO_RING_SLOTS", 0, int,
+    "Shared-memory ring depth in BATCHES for the decode pool.  0 "
+    "(default): auto — 2*workers + 2, each worker one batch ahead "
+    "plus a double-buffer margin.  Explicit values must be >= 2 "
+    "(one slot filling + one draining); anything else raises at "
+    "construction.")
+register_env(
+    "MXNET_IO_DEVICE_AUGMENT", 0, int,
+    "1: ImageRecordIter(device_augment=None) yields raw uint8 NHWC "
+    "batches (4x fewer H2D bytes) and crop/flip/normalize/mixup run "
+    "ON DEVICE as a fused jitted prologue of the training step, under "
+    "the per-step PRNG key (checkpoint resume replays augmentation "
+    "bit-exactly).  0 (default): host-side cv2 augmentation.  Values "
+    "other than 0/1 raise at construction.")
+register_env(
     "MXNET_CKPT_DIR", None, str,
     "Checkpoint root directory.  When set, Module.fit creates a "
     "CheckpointManager automatically (cadence from "

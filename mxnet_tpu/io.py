@@ -465,6 +465,23 @@ class PrefetchingIter(DataIter):
                      for x in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
+    @property
+    def device_prologue(self):
+        """Forward the wrapped iterator's device-side augment prologue
+        (``ImageRecordIter(device_augment=1)``) so ``Module.fit`` finds
+        it through the prefetch wrapper too."""
+        if self.n_iter == 1:
+            return getattr(self.iters[0], "device_prologue", None)
+        if any(getattr(i, "device_prologue", None) is not None
+               for i in self.iters):
+            # silently dropping it would feed raw uint8 NHWC batches to
+            # a final-shape executor and die far from the cause
+            raise MXNetError(
+                "device_augment iterators cannot be combined in a "
+                "multi-iterator PrefetchingIter (one prologue per "
+                "module); rebuild them with device_augment=0")
+        return None
+
     def reset(self):
         self._gen += 1
         self._epoch_done = False

@@ -1,0 +1,695 @@
+"""Multi-process JPEG-decode pool with a zero-copy shared-memory ring.
+
+The host input path's answer to the 7x real-vs-synthetic throughput
+gap (PERF.md "Input pipeline"): one Python process tops out at ~1.1k
+img/s of decode+augment while the chip consumes ~2.6k, so the decode
+work must fan out over host cores the same way the reference sizes its
+OMP decode loop against accelerator speed
+(``src/io/iter_image_recordio.cc:29-120``).
+
+Design
+------
+* **Batch-granular fan-out.**  Batch ``b`` of an epoch is wholly owned
+  by worker ``b % num_workers`` and written into ring slot
+  ``b % ring_slots`` of a ``multiprocessing.shared_memory`` block.
+  The trainer consumes batches strictly in order, so the slot→batch
+  mapping is deterministic and epochs are bit-reproducible for any
+  worker count (per-sample augmentation RNG is keyed on
+  ``(seed, epoch, record_offset)``, never on scheduling).
+* **Lock-free ring.**  Producers gate on ``consumed`` (batches the
+  trainer has finished with) before overwriting a slot; the consumer
+  gates on ``ready[slot] == b``.  Both are plain shared int64 cells
+  polled at sub-millisecond granularity — no cross-process locks, so a
+  ``kill -9``'d worker can never poison a mutex the parent needs.
+* **Fork-based workers.**  Workers inherit the parent's fully
+  constructed ``ImageRecordIter`` (record offsets, label map, mean
+  image — computed ONCE in the parent) by ``fork`` and reopen their
+  own record readers; they never touch jax.  Epoch descriptors
+  (epoch number, shuffle order, start batch) arrive over per-worker
+  pipes, so ``set_state`` resume rebuilds the pool and *skips* straight
+  to the consumer position without re-decoding.
+* **Self-healing.**  The consumer notices a dead batch owner (SIGKILL,
+  OOM) while waiting, rebuilds the whole pool, and re-enters the epoch
+  at the exact next undelivered batch — no dropped or duplicated batch.
+  Workers watch ``getppid()`` so a ``kill -9``'d trainer never leaves
+  orphan decoders behind.
+
+``make_device_prologue`` builds the other half of the tentpole: the
+fused jitted device prologue (crop/flip/normalize/mixup) that consumes
+the pool's raw uint8 NHWC batches inside the training step, cutting
+H2D bytes 4x and deleting the host augment tax.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import traceback
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from . import profiler as _prof
+from .base import MXNetError
+
+__all__ = ["DecodePool", "make_device_prologue", "resolve_workers",
+           "resolve_ring_slots", "resolve_device_augment"]
+
+_POLL_S = 0.0005          # ring poll granularity (sub-ms; ~batch ≫ this)
+_FENCE_LOCK = threading.Lock()  # process-local; see _fence()
+_LIVENESS_EVERY_S = 0.25  # how often waiters re-check process liveness
+_MAX_REBUILDS_PER_BATCH = 3  # self-heal attempts before declaring the
+                             # batch poisoned (deterministic decoder crash)
+
+
+def _fence():
+    """Best-effort memory barrier between the ring's data stores and
+    its control-cell stores (and the mirror-image loads on the
+    consumer).  The lock round-trip compiles to acquire/release
+    atomics on every architecture; together with the barriers CPython
+    itself issues around the GIL and syscalls this closes the
+    store-reorder window on weakly-ordered CPUs (aarch64).  The
+    protocol is formally sequenced only under total-store-order (x86 —
+    every current TPU/GPU host); on other platforms ``workers=0``
+    remains the conservative fallback.  Process-local by construction,
+    so a SIGKILL'd peer can never leave it held."""
+    with _FENCE_LOCK:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# io env-var handling (MXNET_IO_WORKERS / MXNET_IO_RING_SLOTS /
+# MXNET_IO_DEVICE_AUGMENT) — loud validation at construction, matching
+# the checkpoint knobs' pattern (garbage raises, never limps).
+# ---------------------------------------------------------------------------
+
+def _int_env(name, default):
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise MXNetError(
+            f"{name}={raw!r} is not an integer; see mx.config.describe"
+            f"({name!r})") from None
+
+
+def resolve_workers(workers=None):
+    """Effective decode-pool worker count.
+
+    ``workers=None`` reads ``MXNET_IO_WORKERS`` (unset → 0, the
+    single-process fallback path); ``workers='auto'`` (or -1) sizes the
+    pool at ``min(cpu_count, 8)`` — the env var, when set, wins over
+    'auto'.  Anything else must be an int >= 0."""
+    if workers in ("auto", -1):
+        if os.environ.get("MXNET_IO_WORKERS") not in (None, ""):
+            # an explicitly set env var wins over 'auto' — including an
+            # explicit 0 forcing the single-process path fleet-wide
+            return resolve_workers(None)
+        return min(os.cpu_count() or 1, 8)
+    if workers is None:
+        workers = _int_env("MXNET_IO_WORKERS", 0)
+    if not isinstance(workers, (int, np.integer)) or workers < 0:
+        raise MXNetError(
+            f"workers={workers!r}: want an int >= 0, 'auto', or None "
+            "(None reads MXNET_IO_WORKERS)")
+    return int(workers)
+
+
+def resolve_ring_slots(ring_slots, workers):
+    """Effective ring depth: explicit arg > MXNET_IO_RING_SLOTS > auto
+    (2*workers + 2 — each worker can be one batch ahead plus a
+    double-buffer margin for the consumer).  Must be >= 2."""
+    if ring_slots is None:
+        ring_slots = _int_env("MXNET_IO_RING_SLOTS", 0) or None
+    if ring_slots is None:
+        return 2 * max(workers, 1) + 2
+    if not isinstance(ring_slots, (int, np.integer)) or ring_slots < 2:
+        raise MXNetError(
+            f"ring_slots={ring_slots!r} (or MXNET_IO_RING_SLOTS): want an "
+            "int >= 2 (one slot filling + one draining)")
+    return int(ring_slots)
+
+
+def resolve_device_augment(device_augment=None):
+    """Effective device-augment flag; ``None`` reads
+    MXNET_IO_DEVICE_AUGMENT.  Explicit values get the same loud 0/1
+    validation as the env var (``--device-augment 10`` is a typo, not
+    an opt-in)."""
+    if device_augment is None:
+        v = _int_env("MXNET_IO_DEVICE_AUGMENT", 0)
+    elif isinstance(device_augment, (bool, np.bool_)):
+        return bool(device_augment)
+    elif isinstance(device_augment, (int, np.integer)):
+        v = int(device_augment)
+    else:
+        raise MXNetError(
+            f"device_augment={device_augment!r}: want 0 or 1 "
+            "(None reads MXNET_IO_DEVICE_AUGMENT)")
+    if v not in (0, 1):
+        raise MXNetError(
+            f"device_augment={v!r} (or MXNET_IO_DEVICE_AUGMENT): "
+            "want 0 or 1")
+    return bool(v)
+
+
+# ---------------------------------------------------------------------------
+# epoch batch math — shared by the consumer and the workers so both
+# sides agree exactly on batch count, sample indices, and pad
+# ---------------------------------------------------------------------------
+
+def epoch_num_batches(num_data, batch_size, round_batch):
+    nb = num_data // batch_size
+    if num_data % batch_size and round_batch:
+        nb += 1
+    return nb
+
+
+def batch_indices(order, b, batch_size, num_data):
+    """Sample indices of batch ``b`` under ``order`` — identical to the
+    single-process ``ImageRecordIter.next()`` slicing, including the
+    modular wrap of the padded last batch."""
+    start = b * batch_size
+    stop = start + batch_size
+    idxs = order[start:min(stop, num_data)]
+    if stop > num_data:
+        idxs = np.concatenate(
+            [idxs, order[np.arange(stop - num_data) % num_data]])
+    return idxs
+
+
+# ---------------------------------------------------------------------------
+# the pool
+# ---------------------------------------------------------------------------
+
+class DecodePool:
+    """N fork-spawned decode workers feeding a shared-memory batch ring.
+
+    ``source`` is the owning ``ImageRecordIter``; the pool calls its
+    ``_decode_batch_into(idxs, epoch, data_out, label_out)`` inside the
+    workers (inherited via fork — config, offsets, label map and mean
+    image are computed once in the parent and shared for free)."""
+
+    # a batch owner that is ALIVE but wedged in native code (cv2
+    # spinning on a pathological JPEG) never trips the is_alive()
+    # watchdog — after this many seconds with no publish the consumer
+    # treats it as dead and rebuilds (raise-at-wait, never a silent
+    # hang; the teardown SIGKILL path reaps the wedged process).  A
+    # deterministic wedge then hits the per-batch rebuild cap and
+    # raises like any other poisoned batch.  Class attribute so tests
+    # (and desperate operators) can lower it.
+    stall_timeout_s = 300.0
+
+    def __init__(self, source, num_workers, ring_slots, slot_shape,
+                 slot_dtype, logger=logging):
+        import multiprocessing as mp
+
+        if num_workers < 1:
+            raise MXNetError(f"DecodePool needs >= 1 worker, got {num_workers}")
+        try:
+            self._mp = mp.get_context("fork")
+        except ValueError:
+            raise MXNetError(
+                "DecodePool needs the 'fork' start method (Linux); use "
+                "workers=0 on this platform") from None
+        self._source = source
+        self._logger = logger
+        self.num_workers = int(num_workers)
+        self.ring_slots = int(ring_slots)
+        self._batch_size = int(source.batch_size)
+        self._label_width = int(source.label_width)
+        self._slot_shape = tuple(slot_shape)
+        self._slot_dtype = np.dtype(slot_dtype)
+
+        S, B = self.ring_slots, self._batch_size
+        data_bytes = S * B * int(np.prod(self._slot_shape)) * \
+            self._slot_dtype.itemsize
+        label_bytes = S * B * self._label_width * 4
+        self._shm_data = shared_memory.SharedMemory(
+            create=True, size=max(data_bytes, 1))
+        self._shm_label = shared_memory.SharedMemory(
+            create=True, size=max(label_bytes, 1))
+        self._data = np.ndarray((S, B) + self._slot_shape,
+                                self._slot_dtype, buffer=self._shm_data.buf)
+        self._label = np.ndarray((S, B, self._label_width), np.float32,
+                                 buffer=self._shm_label.buf)
+        # the epoch's shuffle order also lives in shared memory: at
+        # ImageNet scale it is ~10 MB of int64, which must not be
+        # re-pickled through N pipes at every epoch start/rebuild.
+        # Workers only read it after an ("epoch", ...) message, and the
+        # consumer only rewrites it while every worker is idle (fresh
+        # epoch) or gone (rebuild), so no cell is ever read mid-write.
+        self._num_data = int(source.num_data)
+        self._shm_order = shared_memory.SharedMemory(
+            create=True, size=max(self._num_data * 8, 1))
+        self._order_arr = np.ndarray((self._num_data,), np.int64,
+                                     buffer=self._shm_order.buf)
+
+        # lock-free shared control cells (no mutex a SIGKILL can poison)
+        self._ready = self._mp.Array("q", S, lock=False)      # slot -> batch id
+        self._consumed = self._mp.Value("q", 0, lock=False)   # batches done
+        self._alive = self._mp.Value("i", 1, lock=False)
+        self._err_flag = self._mp.Value("i", 0, lock=False)
+        self._dec_start = self._mp.Array("d", S, lock=False)  # perf_counter s
+        self._dec_dur = self._mp.Array("d", S, lock=False)
+        self._dec_pid = self._mp.Array("q", S, lock=False)
+        self._err_q = self._mp.SimpleQueue()
+
+        self._procs = []
+        self._pipes = []
+        self._epoch = None       # (epoch, order, n_batches)
+        self._next_batch = 0
+        self._n_batches = 0
+        self._rebuilds = 0
+        # self-heal bound: a worker that dies deterministically on the
+        # SAME batch (corrupt record segfaulting cv2, kernel OOM-kill
+        # on an oversized image — native crashes leave no traceback in
+        # _err_q) must fail the epoch loudly, not rebuild forever
+        self._rebuild_batch = -1
+        self._rebuilds_at_batch = 0
+        self._spawn()
+
+    # -- lifecycle -----------------------------------------------------
+    def _spawn(self):
+        for s in range(self.ring_slots):
+            self._ready[s] = -1
+        self._alive.value = 1
+        self._err_flag.value = 0
+        self._procs, self._pipes = [], []
+        import warnings
+
+        for wid in range(self.num_workers):
+            parent_conn, child_conn = self._mp.Pipe()
+            p = self._mp.Process(
+                target=_worker_main, daemon=True,
+                args=(self, self._source, wid, child_conn),
+                name=f"mxtpu-io-{wid}")
+            with warnings.catch_warnings():
+                # jax warns on ANY os.fork(); these workers are pure
+                # numpy/cv2 and never enter jax, so the fork is safe
+                warnings.filterwarnings(
+                    "ignore", message=".*os.fork.*", category=RuntimeWarning)
+                p.start()
+            child_conn.close()
+            self._procs.append(p)
+            self._pipes.append(parent_conn)
+
+    def _teardown_procs(self):
+        self._alive.value = 0
+        for conn in self._pipes:
+            try:
+                conn.send(("stop",))
+            except (BrokenPipeError, OSError):
+                pass
+        deadline = time.time() + 2.0
+        for p in self._procs:
+            p.join(timeout=max(0.0, deadline - time.time()))
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=1.0)
+        for p in self._procs:
+            if p.is_alive():
+                # wedged in native code (oversized-JPEG cv2 decode):
+                # SIGKILL rather than leak an orphan that keeps writing
+                # into a ring we are about to unlink
+                p.kill()
+                p.join(timeout=1.0)
+        for conn in self._pipes:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._procs, self._pipes = [], []
+
+    def close(self):
+        if self._shm_data is None:
+            return
+        self._teardown_procs()
+        for shm in (self._shm_data, self._shm_label, self._shm_order):
+            try:
+                shm.close()
+                shm.unlink()
+            except (FileNotFoundError, OSError):
+                pass
+        self._data = self._label = self._order_arr = None
+        self._shm_data = self._shm_label = self._shm_order = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- epoch control -------------------------------------------------
+    def begin_epoch(self, epoch, order, start_batch=0):
+        """Start producing ``epoch`` under ``order`` from ``start_batch``
+        (the set_state skip position).  Abandons any half-consumed
+        previous epoch by rebuilding the workers — the only moment ring
+        state may be reset is with no producer mid-write.  That re-fork
+        is paid on EVERY reset by a consumer that never drains its
+        epoch (e.g. ``score(it, num_batch=N)`` each cycle against a
+        pool iterator); such periodic partial readers should use
+        ``workers=0`` for the small eval iterator, or drain it."""
+        if self._shm_data is None:
+            raise MXNetError("DecodePool is closed")
+        order = np.ascontiguousarray(np.asarray(order, np.int64))
+        if len(order) != self._num_data:
+            raise MXNetError(
+                f"begin_epoch: order has {len(order)} entries, the pool "
+                f"was sized for {self._num_data} records")
+        n_batches = epoch_num_batches(len(order), self._batch_size,
+                                      self._source.round_batch)
+        mid_epoch = self._epoch is not None and \
+            self._next_batch < self._n_batches
+        if mid_epoch or any(not p.is_alive() for p in self._procs):
+            self._teardown_procs()
+            self._spawn()
+        self._epoch = (int(epoch), order, n_batches)
+        self._n_batches = n_batches
+        self._next_batch = int(start_batch)
+        self._order_arr[:] = order  # published before any worker is told
+        msg = ("epoch", int(epoch), n_batches, int(start_batch))
+        for attempt in (0, 1):
+            for s in range(self.ring_slots):
+                self._ready[s] = -1
+            self._consumed.value = int(start_batch)
+            try:
+                for conn in self._pipes:
+                    conn.send(msg)
+                return
+            except (BrokenPipeError, OSError):
+                # a worker died between the liveness check and the
+                # send: rebuild once and retry (same self-heal as the
+                # consume-side death detection)
+                if attempt:
+                    raise MXNetError("decode pool workers keep dying "
+                                     "at epoch start") from None
+                self._teardown_procs()
+                self._spawn()
+
+    def _rebuild_mid_epoch(self):
+        """A batch owner died: rebuild every worker and re-enter the
+        epoch at the next undelivered batch.  Other workers' completed
+        (but unconsumed) slots are re-decoded — determinism makes the
+        re-decode byte-identical, so nothing is dropped or duplicated."""
+        self._rebuilds += 1
+        _prof.inc_counter("io.pool_rebuilds")
+        if self._next_batch == self._rebuild_batch:
+            self._rebuilds_at_batch += 1
+        else:
+            self._rebuild_batch = self._next_batch
+            self._rebuilds_at_batch = 1
+        if self._rebuilds_at_batch > _MAX_REBUILDS_PER_BATCH:
+            # fatal: stop the fleet before raising — the previous
+            # rebuild's fresh workers would otherwise spin in the
+            # backpressure poll forever (the parent is still alive)
+            self._teardown_procs()
+            raise MXNetError(
+                f"decode pool: workers died {self._rebuilds_at_batch} "
+                f"times in a row decoding batch {self._next_batch} of "
+                f"epoch {self._epoch[0]} — a record in that batch "
+                "likely crashes the decoder (corrupt JPEG / OOM-sized "
+                "image); inspect it with tools/im2rec.py or drop "
+                "workers=0 to decode it in-process for a traceback")
+        epoch, order, _ = self._epoch
+        self._logger.warning(
+            "[io_pool] decode worker died; rebuilding %d workers and "
+            "resuming epoch %d at batch %d", self.num_workers, epoch,
+            self._next_batch)
+        self._teardown_procs()
+        self._spawn()
+        self._epoch = None  # force the fresh-epoch path in begin_epoch
+        self.begin_epoch(epoch, order, start_batch=self._next_batch)
+
+    def _raise_worker_error(self):
+        msgs = []
+        try:
+            while not self._err_q.empty():
+                msgs.append(self._err_q.get())
+        except OSError:
+            pass
+        detail = "\n".join(f"[worker {w}] {m}" for w, m in msgs) or \
+            "(no traceback captured)"
+        self._teardown_procs()  # fatal: no survivors left busy-polling
+        raise MXNetError(f"decode pool worker failed:\n{detail}")
+
+    # -- consumption ---------------------------------------------------
+    def next_batch(self):
+        """Copy the next in-order batch out of the ring.
+
+        Returns ``(data, label, batch_id)`` or ``None`` at epoch end.
+        ``data``/``label`` are fresh numpy arrays (the slot is released
+        for overwrite before returning)."""
+        if self._epoch is None:
+            raise MXNetError("DecodePool.next_batch before begin_epoch")
+        b = self._next_batch
+        if b >= self._n_batches:
+            return None
+        slot = b % self.ring_slots
+        wait_start = last_liveness = time.perf_counter()
+        while True:
+            if self._err_flag.value:
+                self._raise_worker_error()
+            if int(self._ready[slot]) == b:
+                _fence()  # pair of the producer's pre-publish fence
+                break
+            now = time.perf_counter()
+            if now - last_liveness > _LIVENESS_EVERY_S:
+                last_liveness = now
+                owner = self._procs[b % self.num_workers]
+                if not owner.is_alive():
+                    if self._err_flag.value:  # died reporting an error
+                        self._raise_worker_error()
+                    self._rebuild_mid_epoch()
+                    slot = b % self.ring_slots
+                    wait_start = time.perf_counter()
+                elif now - wait_start > self.stall_timeout_s:
+                    self._logger.warning(
+                        "[io_pool] batch %d unpublished after %.0fs with "
+                        "a live owner (worker wedged in native decode?); "
+                        "rebuilding", b, now - wait_start)
+                    self._rebuild_mid_epoch()
+                    slot = b % self.ring_slots
+                    wait_start = time.perf_counter()
+            time.sleep(_POLL_S)
+        data = np.array(self._data[slot])
+        label = np.array(self._label[slot])
+        pid = int(self._dec_pid[slot])
+        dec_start, dec_dur = self._dec_start[slot], self._dec_dur[slot]
+        self._next_batch = b + 1
+        _fence()  # slot copy-out drains before releasing it
+        self._consumed.value = b + 1  # release: producers may overwrite
+        # telemetry: decode lanes + ring occupancy next to fit.step
+        _prof.add_event("io.decode", dec_start, dec_dur, cat="io",
+                        args={"worker_pid": pid, "batch": b,
+                              "images": int(data.shape[0])})
+        ready_ahead = sum(1 for s in range(self.ring_slots)
+                          if int(self._ready[s]) > b)
+        _prof.set_gauge("io.decode_queue_depth", float(ready_ahead))
+        _prof.set_gauge("io.ring_free_slots",
+                        float(self.ring_slots - ready_ahead))
+        return data, label, b
+
+    @property
+    def worker_pids(self):
+        return [p.pid for p in self._procs]
+
+
+def _worker_main(pool, source, wid, conn):
+    """Decode-worker process body (entered via fork).
+
+    Owns batches ``b % num_workers == wid``; for each, waits for its
+    ring slot to free, decodes the batch straight into shared memory,
+    and publishes ``ready[slot] = b``.  Exits when told to stop, when
+    the pool's alive flag drops, or when the parent process dies
+    (``getppid`` reparenting — a kill -9'd trainer must not leave
+    orphan decoders)."""
+    ppid = os.getppid()
+    code = 0
+    try:
+        # A fork taken while another trainer thread (e.g. a second
+        # pool's PrefetchingIter producer) sits inside _fence() inherits
+        # _FENCE_LOCK in the held state with no thread to release it —
+        # and the first _fence() here would wedge every fresh worker.
+        global _FENCE_LOCK
+        _FENCE_LOCK = threading.Lock()
+        import signal
+        # drop inherited handlers: the trainer may have installed a
+        # CheckpointManager SIGTERM hook (emergency sync save) — run
+        # in a forked child it would enter jax collectives and write
+        # into the live checkpoint dir, corrupting the commit protocol.
+        # Default disposition also lets _teardown_procs' terminate()
+        # actually kill a busy worker.
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        try:
+            import cv2
+            cv2.setNumThreads(0)  # one decode lane per process
+        except ImportError:
+            pass
+        source._worker_reset_after_fork()
+        W, S, B = pool.num_workers, pool.ring_slots, pool._batch_size
+        num_data = source.num_data
+
+        def parent_gone():
+            return os.getppid() != ppid
+
+        while pool._alive.value:
+            if not conn.poll(0.5):
+                if parent_gone():
+                    return
+                continue
+            msg = conn.recv()
+            if msg[0] == "stop":
+                return
+            _, epoch, n_batches, start_batch = msg
+            order = pool._order_arr  # shm, inherited mapping; stable
+            # for the whole epoch (the parent only rewrites it while
+            # every worker idles at this poll loop)
+            b = start_batch + ((wid - start_batch) % W)
+            while b < n_batches:
+                spins = 0
+                while pool._alive.value and \
+                        b - int(pool._consumed.value) >= S:
+                    time.sleep(_POLL_S)
+                    spins += 1
+                    if spins % 512 == 0 and parent_gone():
+                        return
+                if not pool._alive.value:
+                    break
+                _fence()  # pair of the consumer's pre-release fence
+                slot = b % S
+                idxs = batch_indices(order, b, B, num_data)
+                t0 = time.perf_counter()
+                source._decode_batch_into(idxs, epoch,
+                                          pool._data[slot],
+                                          pool._label[slot])
+                pool._dec_start[slot] = t0
+                pool._dec_dur[slot] = time.perf_counter() - t0
+                pool._dec_pid[slot] = os.getpid()
+                _fence()  # data stores drain before the publish
+                pool._ready[slot] = b  # publish AFTER the slot is full
+                b += W
+    except (EOFError, KeyboardInterrupt):
+        code = 0
+    except Exception:
+        try:
+            pool._err_q.put((wid, traceback.format_exc()))
+            pool._err_flag.value = 1
+        except Exception:
+            pass
+        code = 1
+    finally:
+        # skip atexit: the forked child inherited the parent's jax/XLA
+        # state and must not run its teardown hooks
+        os._exit(code)
+
+
+# ---------------------------------------------------------------------------
+# device-side augmentation: the fused jitted prologue of the training
+# step.  Consumes the pool's raw uint8 NHWC batches ON DEVICE — the
+# crop/flip/normalize/mixup work leaves the per-sample host loop, and
+# the H2D transfer shrinks 4x (uint8 vs f32).
+# ---------------------------------------------------------------------------
+
+def make_device_prologue(data_name, data_shape, pre_shape, out_dtype,
+                         rand_crop=False, rand_mirror=False, mean=None,
+                         std=None, scale=1.0, mixup_alpha=0.0):
+    """Build ``prologue(inputs, rng, train) -> inputs``.
+
+    ``inputs[data_name]`` is a raw ``(B, preH, preW, C)`` uint8 batch;
+    the result is the augmented+normalized ``(B, C, H, W)``
+    ``out_dtype`` batch the bound graph expects.  ``train=True`` runs
+    random crop / mirror / mixup under ``rng`` (the fused step derives
+    it from the per-step PRNG key, so checkpoint resume replays the
+    augmentation stream bit-exactly); ``train=False`` is the
+    deterministic eval path (center crop, no flip/mixup).
+
+    Already-final inputs (shape ``(B, C, H, W)`` — e.g. a validation
+    NDArrayIter feeding the same module) pass through untouched except
+    for the dtype cast, so one installed prologue serves mixed
+    pipelines.
+
+    Mixup note: labels here are hard class ids, so ``mixup_alpha > 0``
+    uses the label-preserving fold ``lam = max(lam, 1-lam)`` (the
+    original image stays dominant and keeps its label) rather than
+    soft-target mixing, which would need a loss-side change."""
+    import jax
+    import jax.numpy as jnp
+
+    C, H, W = map(int, data_shape)
+    preH, preW = map(int, pre_shape)
+    mean_c = None if mean is None else jnp.asarray(mean, jnp.float32)
+    std_c = None if std is None else jnp.asarray(std, jnp.float32)
+    scale = float(scale)
+    mixup_alpha = float(mixup_alpha)
+
+    def prologue(inputs, rng, train):
+        x = inputs.get(data_name)
+        if x is None:
+            return inputs
+        if tuple(x.shape[1:]) != (preH, preW, C):
+            if tuple(x.shape[1:]) == (C, H, W):  # already final: cast only
+                out = dict(inputs)
+                out[data_name] = x.astype(out_dtype)
+                return out
+            raise MXNetError(
+                f"device prologue: input {data_name!r} has shape "
+                f"{tuple(x.shape)}, want (batch, {preH}, {preW}, {C}) "
+                f"raw or (batch, {C}, {H}, {W}) final")
+        B = x.shape[0]
+        k_cy, k_cx, k_flip, k_perm, k_lam = jax.random.split(rng, 5)
+        if (preH, preW) != (H, W):
+            if train and rand_crop:
+                ys = jax.random.randint(k_cy, (B,), 0, preH - H + 1)
+                xs = jax.random.randint(k_cx, (B,), 0, preW - W + 1)
+            else:
+                ys = jnp.full((B,), (preH - H) // 2, jnp.int32)
+                xs = jnp.full((B,), (preW - W) // 2, jnp.int32)
+            x = jax.vmap(
+                lambda img, y0, x0: jax.lax.dynamic_slice(
+                    img, (y0, x0, 0), (H, W, C)))(x, ys, xs)
+        if train and rand_mirror:
+            flip = jax.random.bernoulli(k_flip, 0.5, (B,))
+            x = jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+        x = x.astype(jnp.float32)
+        if train and mixup_alpha > 0.0:
+            lam = jax.random.beta(k_lam, mixup_alpha, mixup_alpha)
+            lam = jnp.maximum(lam, 1.0 - lam)  # label-preserving fold
+            perm = jax.random.permutation(k_perm, B)
+            x = lam * x + (1.0 - lam) * x[perm]
+        x = x.transpose(0, 3, 1, 2)  # NHWC -> NCHW
+        if mean_c is not None:
+            x = x - mean_c
+        if std_c is not None:
+            x = x / std_c
+        if scale != 1.0:
+            x = x * scale
+        out = dict(inputs)
+        out[data_name] = x.astype(out_dtype)
+        return out
+
+    return prologue
+
+
+def default_pre_shape(data_shape, resize=0, rand_crop=False):
+    """Fixed host-side decode target for the device-augment path: the
+    uint8 NHWC window every record lands in before it enters the ring
+    (aspect-preserving cover-resize + center crop — the legacy
+    ResizeAug short-edge semantics, never a warping square resize).
+    ``resize`` (when given) wins; otherwise random-crop mode leaves an
+    8/7 jitter margin (224 -> 256, the classic ImageNet ratio) and
+    no-crop mode decodes straight to the final size."""
+    _, H, W = data_shape
+    if resize and resize > 0:
+        if resize < max(H, W):
+            raise MXNetError(
+                f"device_augment: resize={resize} is smaller than the "
+                f"crop target {max(H, W)}")
+        return (int(resize), int(resize))
+    if rand_crop:
+        return (int(H * 8 / 7), int(W * 8 / 7))
+    return (int(H), int(W))

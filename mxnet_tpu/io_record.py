@@ -12,6 +12,18 @@ JPEG decode + augmentation run in a thread pool (cv2 releases the GIL),
 normalization is vectorized per batch, and device staging/overlap comes
 from wrapping in ``PrefetchingIter(ctx=...)`` rather than a bespoke
 prefetch thread — one prefetch mechanism for every iterator.
+
+Scaling past one process (the 7x real-vs-synthetic gap, PERF.md "Input
+pipeline"): ``workers=N`` fans the decode out to N processes writing a
+zero-copy shared-memory ring (``mxnet_tpu.io_pool.DecodePool``), and
+``device_augment=1`` moves crop/flip/normalize/mixup onto the device as
+a fused jitted prologue of the training step — the iterator then yields
+raw uint8 NHWC batches (4x fewer H2D bytes) plus a ``device_prologue``
+that ``Module.fit`` installs automatically.  ``workers=0`` (default)
+keeps the original single-process path; both modes preserve the exact
+``state_dict``/``set_state`` resume contract (the pool is torn down,
+rebuilt under the restored order, and skipped to the consumer
+position).
 """
 
 from __future__ import annotations
@@ -25,12 +37,34 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from . import image as _image
+from . import io_pool as _iopool
 from . import ndarray as nd
 from . import recordio as rio
 from .base import MXNetError
 from .io import DataBatch, DataDesc, DataIter
 
 __all__ = ["ImageRecordIter"]
+
+# mean images already computed/loaded this process, keyed by absolute
+# path: N consumers (or a parent about to fork a decode pool) pay the
+# full-dataset pass / file read ONCE — workers then inherit the array
+# through fork for free
+_MEAN_CACHE = {}
+_MEAN_CACHE_LOCK = threading.Lock()
+
+
+def _stage_batch(arr):
+    """Freshly assembled batch buffer -> NDArray via ``io.stage_array``:
+    the transfer starts asynchronously, the bytes land in the
+    ``io.h2d_bytes`` counter (the uint8-vs-f32 wire saving is a
+    first-class metric), and — unlike ``nd.array`` — no defensive copy
+    is made, because the buffer is this iterator's own and never
+    reused."""
+    from .io import stage_array
+    from .ndarray import NDArray, _device
+
+    ctx, dev = _device(None)
+    return NDArray(stage_array(arr, dev), ctx)
 
 
 class ImageRecordIter(DataIter):
@@ -45,6 +79,26 @@ class ImageRecordIter(DataIter):
     (``mean_img`` file caching like iter_normalize.h), and the
     augmentation knobs (resize, rand_crop, rand_mirror, rotate/shear/
     scale/aspect, HSL).
+
+    TPU data-plane extensions:
+
+    ``workers``
+        0 (default): decode in-process.  N > 0: delegate decode to an
+        N-process ``DecodePool`` over a shared-memory ring; ``'auto'``
+        sizes it ``min(cpu_count, 8)``.  ``None`` reads
+        ``MXNET_IO_WORKERS``.
+    ``device_augment``
+        1: the iterator yields raw uint8 NHWC batches (host does decode
+        + one fixed resize only) and exposes ``device_prologue`` — the
+        fused jitted crop/flip/normalize/mixup that runs inside the
+        training step under the per-step PRNG key.  ``None`` reads
+        ``MXNET_IO_DEVICE_AUGMENT``.
+    ``ring_slots``
+        Ring depth in batches (``None``: ``MXNET_IO_RING_SLOTS`` or
+        ``2*workers + 2``).
+    ``mixup_alpha``
+        Beta(alpha, alpha) batch mixup in the device prologue
+        (requires ``device_augment=1``).
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size,
@@ -59,7 +113,9 @@ class ImageRecordIter(DataIter):
                  fill_value=255, inter_method=None,
                  num_parts=1, part_index=0, round_batch=True,
                  preprocess_threads=4, data_name="data",
-                 label_name="softmax_label", dtype="float32", **kwargs):
+                 label_name="softmax_label", dtype="float32",
+                 workers=None, device_augment=None, ring_slots=None,
+                 mixup_alpha=0.0, **kwargs):
         super().__init__(batch_size)
         if kwargs:
             # the reference C++ iterator rejects unknown parameters too
@@ -89,6 +145,45 @@ class ImageRecordIter(DataIter):
         self._tls = threading.local()
         self._readers = []
         self._readers_lock = threading.Lock()
+
+        # --- data-plane mode (validated loudly AT CONSTRUCTION, like
+        # the checkpoint knobs: garbage env values raise here) --------
+        self._workers = _iopool.resolve_workers(workers)
+        self._device_augment = _iopool.resolve_device_augment(device_augment)
+        self._ring_slots = _iopool.resolve_ring_slots(ring_slots,
+                                                      self._workers)
+        self._mixup_alpha = float(mixup_alpha)
+        if self._mixup_alpha < 0:
+            raise MXNetError(f"mixup_alpha={mixup_alpha!r} must be >= 0")
+        if self._mixup_alpha and not self._device_augment:
+            raise MXNetError("mixup_alpha needs device_augment=1 (mixup "
+                             "runs in the device prologue)")
+        self._rand_crop = bool(rand_crop)
+        self._rand_mirror = bool(rand_mirror)
+        self._dpool = None
+        self._dpool_epoch_sent = False
+        self._prologue = None
+        if self._device_augment:
+            unsupported = {
+                "rand_resize": rand_resize, "max_rotate_angle": max_rotate_angle,
+                "max_shear_ratio": max_shear_ratio,
+                "max_aspect_ratio": max_aspect_ratio,
+                "random_h": random_h, "random_s": random_s,
+                "random_l": random_l,
+                "min_random_scale": (min_random_scale
+                                     if min_random_scale != 1.0 else 0),
+                "max_random_scale": (max_random_scale
+                                     if max_random_scale != 1.0 else 0)}
+            bad = sorted(k for k, v in unsupported.items() if v)
+            if bad:
+                raise MXNetError(
+                    "device_augment=1 supports crop/flip/normalize/mixup "
+                    f"on device; unsupported host augmentations set: {bad} "
+                    "(use device_augment=0 for those)")
+            self._pre_shape = _iopool.default_pre_shape(
+                self.data_shape, resize=resize, rand_crop=rand_crop)
+            # the one host-side resize honors the user's interpolation
+            self._inter_method = inter_method
 
         # --- optional label map: image id -> fresh labels, overriding
         # the labels packed in the records (reference: "supply a list
@@ -122,16 +217,21 @@ class ImageRecordIter(DataIter):
             raise MXNetError("fewer records than batch_size in this part")
 
         # --- augmentation pipeline ------------------------------------
-        self._auglist = _image.CreateAugmenter(
-            self.data_shape, resize=resize, rand_crop=rand_crop,
-            rand_resize=rand_resize, rand_mirror=rand_mirror,
-            random_h=random_h, random_s=random_s, random_l=random_l,
-            max_rotate_angle=max_rotate_angle,
-            max_shear_ratio=max_shear_ratio,
-            max_aspect_ratio=max_aspect_ratio,
-            min_random_scale=min_random_scale,
-            max_random_scale=max_random_scale,
-            fill_value=fill_value, inter_method=inter_method)
+        if self._device_augment:
+            # host side does decode + ONE fixed resize; crop/flip/
+            # normalize/mixup run on device in the fused prologue
+            self._auglist = []
+        else:
+            self._auglist = _image.CreateAugmenter(
+                self.data_shape, resize=resize, rand_crop=rand_crop,
+                rand_resize=rand_resize, rand_mirror=rand_mirror,
+                random_h=random_h, random_s=random_s, random_l=random_l,
+                max_rotate_angle=max_rotate_angle,
+                max_shear_ratio=max_shear_ratio,
+                max_aspect_ratio=max_aspect_ratio,
+                min_random_scale=min_random_scale,
+                max_random_scale=max_random_scale,
+                fill_value=fill_value, inter_method=inter_method)
 
         # --- normalization (iter_normalize.h behavior) ----------------
         c = self.data_shape[0]
@@ -148,13 +248,19 @@ class ImageRecordIter(DataIter):
             self._mean = self._load_or_compute_mean(mean_img)
 
         self._preprocess_threads = max(1, preprocess_threads)
-        self._pool = ThreadPoolExecutor(max_workers=self._preprocess_threads)
+        self._pool = None  # in-process decode executor, created lazily
         self._order = np.arange(self.num_data)
         self._cursor = 0
         self._seen_epoch_end = False
         self.reset()
 
     # ------------------------------------------------------------------
+    def _executor(self):
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._preprocess_threads)
+        return self._pool
+
     def _read_at(self, offset):
         rec = getattr(self._tls, "record", None)
         if rec is None:
@@ -168,7 +274,7 @@ class ImageRecordIter(DataIter):
             raise MXNetError("truncated record file")
         return s
 
-    def _decode_one(self, offset, payload=None, out=None):
+    def _decode_one(self, offset, payload=None, out=None, epoch=None):
         c = self.data_shape[0]
         if payload is None:
             payload = self._read_at(offset)
@@ -180,11 +286,60 @@ class ImageRecordIter(DataIter):
                 img = img[:, :, None].repeat(3, axis=2)
             img = img[:, :, ::-1]  # BGR -> RGB (augmenters/means are RGB)
         # per-sample rng: reproducible regardless of thread scheduling
-        rng = _pyrandom.Random(hash((self._seed, self._epoch, int(offset))))
+        # (and of which pool worker decodes the sample)
+        epoch = self._epoch if epoch is None else epoch
+        rng = _pyrandom.Random(hash((self._seed, epoch, int(offset))))
         for aug in self._auglist:
             img = aug(img, rng)
             if img.ndim == 2:
                 img = img[:, :, None]  # cv2 ops drop the dim of (H,W,1)
+        label = self._label_of(header)
+        if out is not None:
+            # single conversion+transpose pass into the caller's batch
+            # buffer (dtype cast fused into the copy)
+            np.copyto(out, img.transpose(2, 0, 1), casting="unsafe")
+            return out, label
+        chw = np.ascontiguousarray(
+            np.asarray(img, np.float32).transpose(2, 0, 1))
+        return chw, label
+
+    def _decode_raw_one(self, offset, payload=None, out=None):
+        """Device-augment decode: JPEG -> RGB -> ONE fixed resize to
+        ``pre_shape`` -> uint8 HWC into ``out`` (a ring-slot row or a
+        local batch buffer).  No host augmentation, no float conversion
+        — that all happens on device in the fused prologue."""
+        import cv2
+
+        c = self.data_shape[0]
+        if payload is None:
+            payload = self._read_at(offset)
+        header, img = rio.unpack_img(payload, iscolor=0 if c == 1 else 1)
+        if c == 1:
+            img = img[:, :, None]
+        else:
+            if img.ndim == 2:
+                img = img[:, :, None].repeat(3, axis=2)
+            img = img[:, :, ::-1]
+        preH, preW = self._pre_shape
+        if img.shape[:2] != (preH, preW):
+            interp = (self._inter_method if self._inter_method is not None
+                      else cv2.INTER_LINEAR)
+            # aspect-preserving cover-resize + center crop into the
+            # fixed ring window — matching the legacy ResizeAug
+            # short-edge semantics, never a warping square resize
+            h, w = img.shape[:2]
+            s = max(preH / h, preW / w)
+            nh = max(preH, int(round(h * s)))
+            nw = max(preW, int(round(w * s)))
+            img = cv2.resize(img, (nw, nh), interpolation=interp)
+            if img.ndim == 2:
+                img = img[:, :, None]
+            y0, x0 = (nh - preH) // 2, (nw - preW) // 2
+            img = img[y0:y0 + preH, x0:x0 + preW]
+        np.copyto(out, img, casting="unsafe")
+        return self._label_of(header)
+
+    def _label_of(self, header):
         if self._label_map is not None:
             label = self._label_map.get(header.id)
             if label is None:
@@ -199,30 +354,118 @@ class ImageRecordIter(DataIter):
             label = label[:self.label_width]
         else:
             label = np.array([label], np.float32)[:self.label_width]
-        if out is not None:
-            # single conversion+transpose pass into the caller's batch
-            # buffer (dtype cast fused into the copy)
-            np.copyto(out, img.transpose(2, 0, 1), casting="unsafe")
-            return out, np.asarray(label, np.float32)
-        chw = np.ascontiguousarray(
-            np.asarray(img, np.float32).transpose(2, 0, 1))
-        return chw, np.asarray(label, np.float32)
+        return np.asarray(label, np.float32)
+
+    # -- decode-pool plumbing ------------------------------------------
+    def _decode_batch_into(self, idxs, epoch, data_out, label_out):
+        """Decode one whole batch into caller-provided buffers (the
+        pool workers' entry point — ``data_out``/``label_out`` are ring
+        slot views, so the decode IS the shared-memory write)."""
+        offsets = self._offsets[np.asarray(idxs)]
+        from . import _native
+        if _native.lib() is not None:
+            # same native batched payload fetch as the workers=0 path
+            # (per-record Python seek/read measured as significant
+            # overhead there); single-threaded — each pool worker IS
+            # one decode lane
+            payloads = rio.read_batch(self._path_imgrec, offsets,
+                                      threads=1)
+        else:
+            payloads = [None] * len(offsets)
+        for j, off in enumerate(offsets):
+            if self._device_augment:
+                label_out[j] = self._decode_raw_one(off, payloads[j],
+                                                    out=data_out[j])
+            else:
+                _, lab = self._decode_one(off, payloads[j],
+                                          out=data_out[j], epoch=epoch)
+                label_out[j] = lab
+
+    def _worker_reset_after_fork(self):
+        """Make a forked decode worker self-contained: fresh record
+        readers (the parent's fds share a file offset — seeking them
+        from two processes races), no inherited thread pool (its
+        threads did not survive the fork), and no pool handle (a worker
+        must never recurse into ring management)."""
+        self._tls = threading.local()
+        self._readers = []
+        self._readers_lock = threading.Lock()
+        self._pool = None
+        self._dpool = None
+
+    def _slot_spec(self):
+        if self._device_augment:
+            return self._pre_shape + (self.data_shape[0],), np.uint8
+        return self.data_shape, np.float32
+
+    def _pool_next(self, expect_b):
+        if self._dpool is None:
+            slot_shape, slot_dtype = self._slot_spec()
+            self._dpool = _iopool.DecodePool(
+                self, self._workers, self._ring_slots, slot_shape,
+                slot_dtype)
+            self._dpool_epoch_sent = False
+        try:
+            if not self._dpool_epoch_sent:
+                self._dpool.begin_epoch(self._epoch, self._order,
+                                        start_batch=expect_b)
+                self._dpool_epoch_sent = True
+            out = self._dpool.next_batch()
+            if out is None or out[2] != expect_b:
+                got = None if out is None else out[2]
+                raise MXNetError(f"decode pool out of sync: expected batch "
+                                 f"{expect_b}, got {got}")
+        except MXNetError:
+            # fatal pool state (poisoned batch, dead fleet, desync):
+            # release the workers and shm NOW rather than at iterator
+            # GC; a caught error followed by reset() gets a fresh pool
+            self._dpool.close()
+            self._dpool = None
+            self._dpool_epoch_sent = False
+            raise
+        return out[0], out[1]
 
     def _load_or_compute_mean(self, mean_path):
+        key = os.path.abspath(mean_path)
+        with _MEAN_CACHE_LOCK:
+            cached = _MEAN_CACHE.get(key)
+        if cached is not None:
+            return cached
         if os.path.isfile(mean_path):
             loaded = nd.load(mean_path)
             arr = (loaded["mean_img"] if isinstance(loaded, dict)
                    else loaded[0])
-            return arr.asnumpy().astype(np.float32)
-        logging.info("ImageRecordIter: computing mean image -> %s", mean_path)
-        acc = np.zeros(self.data_shape, np.float64)
-        n = 0
-        for off in self._offsets:
-            chw, _ = self._decode_one(off)
-            acc += chw
-            n += 1
-        mean = (acc / max(n, 1)).astype(np.float32)
-        nd.save(mean_path, {"mean_img": nd.array(mean)})
+            mean = arr.asnumpy().astype(np.float32)
+        else:
+            logging.info("ImageRecordIter: computing mean image -> %s",
+                         mean_path)
+            acc = np.zeros(self.data_shape, np.float64)
+            n = 0
+            if self._device_augment:
+                # the host augmenter list is empty in this mode, so a
+                # plain _decode_one would keep each record's native
+                # size; accumulate over the fixed-resize + CENTER-crop
+                # view instead — the same data_shape window the device
+                # prologue normalizes at eval time
+                preH, preW = self._pre_shape
+                _, H, W = self.data_shape
+                y0, x0 = (preH - H) // 2, (preW - W) // 2
+                buf = np.empty((preH, preW, self.data_shape[0]), np.uint8)
+                for off in self._offsets:
+                    self._decode_raw_one(off, out=buf)
+                    acc += buf[y0:y0 + H, x0:x0 + W].transpose(2, 0, 1)
+                    n += 1
+            else:
+                for off in self._offsets:
+                    chw, _ = self._decode_one(off)
+                    acc += chw
+                    n += 1
+            mean = (acc / max(n, 1)).astype(np.float32)
+            nd.save(mean_path, {"mean_img": nd.array(mean)})
+        with _MEAN_CACHE_LOCK:
+            # computed ONCE per process; pool workers inherit the array
+            # through fork, so N workers never redo the full pass
+            _MEAN_CACHE[key] = mean
         return mean
 
     # ------------------------------------------------------------------
@@ -237,19 +480,49 @@ class ImageRecordIter(DataIter):
                  else (self.batch_size, self.label_width))
         return [DataDesc(self.label_name, shape, np.float32)]
 
+    @property
+    def raw_provide_data(self):
+        """Shape/dtype of the batches actually yielded: the raw uint8
+        NHWC wire format in device-augment mode (what crosses H2D),
+        else the final descriptor."""
+        if not self._device_augment:
+            return self.provide_data
+        preH, preW = self._pre_shape
+        return [DataDesc(self.data_name,
+                         (self.batch_size, preH, preW, self.data_shape[0]),
+                         np.uint8, layout="NHWC")]
+
+    @property
+    def device_prologue(self):
+        """The fused jitted device-side augment (crop/flip/normalize/
+        mixup) paired with this iterator's raw batches; ``Module.fit``
+        installs it automatically.  None unless ``device_augment=1``."""
+        if not self._device_augment:
+            return None
+        if self._prologue is None:
+            self._prologue = _iopool.make_device_prologue(
+                self.data_name, self.data_shape, self._pre_shape,
+                self.dtype, rand_crop=self._rand_crop,
+                rand_mirror=self._rand_mirror, mean=self._mean,
+                std=self._std, scale=self._scale,
+                mixup_alpha=self._mixup_alpha)
+        return self._prologue
+
     def reset(self):
         if self.shuffle:
             self._rng.shuffle(self._order)
         self._epoch += 1
         self._cursor = 0
         self._seen_epoch_end = False
+        self._dpool_epoch_sent = False  # pool restarts lazily on next()
 
     def state_dict(self):
         return {"kind": "ImageRecordIter", "cursor": int(self._cursor),
                 "order": self._order.copy(), "epoch": int(self._epoch),
                 "seen_epoch_end": bool(self._seen_epoch_end),
                 "rng": self._rng.get_state(), "seed": self._seed,
-                "num_data": int(self.num_data)}
+                "num_data": int(self.num_data),
+                "workers": int(self._workers)}
 
     def set_state(self, state, rewind=False):
         if state.get("kind") != "ImageRecordIter":
@@ -260,6 +533,14 @@ class ImageRecordIter(DataIter):
                 "ImageRecordIter.set_state: snapshot has num_data="
                 f"{state['num_data']}, this iterator has {self.num_data} "
                 "(different record file or sharding?)")
+        # pool mode: tear the workers down FIRST (they may be mid-epoch
+        # under the old order); the pool is rebuilt lazily on the next
+        # next() and told to start straight at the restored batch
+        # position — resume never re-decodes consumed batches
+        if self._dpool is not None:
+            self._dpool.close()
+            self._dpool = None
+        self._dpool_epoch_sent = False
         self._order = np.asarray(state["order"]).copy()
         self._cursor = 0 if rewind else int(state["cursor"])
         self._epoch = int(state["epoch"])
@@ -279,18 +560,49 @@ class ImageRecordIter(DataIter):
         start = self._cursor
         stop = start + self.batch_size
         pad = 0
-        idxs = self._order[start:stop]
+        b = start // self.batch_size
         if stop >= self.num_data:
             self._seen_epoch_end = True
             if stop > self.num_data:
                 if not self.round_batch:
                     raise StopIteration
                 pad = stop - self.num_data
-                # modular wrap: correct even when pad > num_data
-                idxs = np.concatenate(
-                    [idxs, self._order[np.arange(pad) % self.num_data]])
+        # ONE slicing formula (incl. the modular pad wrap) shared with
+        # the pool workers — bit-identical batches for any worker count
+        # by construction, not by keeping two copies in sync
+        idxs = _iopool.batch_indices(self._order, b, self.batch_size,
+                                     self.num_data)
         self._cursor = stop
 
+        if self._workers > 0:
+            data, label = self._pool_next(b)
+        else:
+            data, label = self._decode_batch_local(idxs)
+
+        if self.label_width == 1:
+            label = label[:, 0]
+        if self._device_augment:
+            # raw uint8 NHWC over the wire; crop/flip/normalize/mixup
+            # happen on device in the fused prologue
+            return DataBatch([_stage_batch(data)], [_stage_batch(label)],
+                             pad=pad, index=np.asarray(idxs),
+                             provide_data=self.raw_provide_data,
+                             provide_label=self.provide_label)
+        # vectorized normalize (iter_normalize.h: (img - mean) * scale / std)
+        if self._mean is not None:
+            data -= self._mean
+        if self._std is not None:
+            data /= self._std
+        if self._scale != 1.0:
+            data *= self._scale
+        return DataBatch([_stage_batch(data.astype(self.dtype, copy=False))],
+                         [_stage_batch(label)], pad=pad,
+                         index=np.asarray(idxs),
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def _decode_batch_local(self, idxs):
+        """Single-process batch assembly (the ``workers=0`` path)."""
         offsets = self._offsets[idxs]
         from . import _native
         if _native.lib() is not None:
@@ -311,39 +623,36 @@ class ImageRecordIter(DataIter):
         # iter_image_recordio.cc:29-120 writes into the batch the same
         # way)
         n = len(offsets)
-        data = np.empty((n,) + tuple(self.data_shape), np.float32)
+        slot_shape, slot_dtype = self._slot_spec()
+        data = np.empty((n,) + slot_shape, slot_dtype)
         label = np.empty((n, self.label_width), np.float32)
 
         def work(lo, hi):
             for j in range(lo, hi):
-                chw, lab = self._decode_one(offsets[j], payloads[j],
-                                            out=data[j])
-                label[j] = lab
+                if self._device_augment:
+                    label[j] = self._decode_raw_one(offsets[j], payloads[j],
+                                                    out=data[j])
+                else:
+                    _, lab = self._decode_one(offsets[j], payloads[j],
+                                              out=data[j])
+                    label[j] = lab
 
         nchunk = min(self._preprocess_threads, n) or 1
         bounds = np.linspace(0, n, nchunk + 1, dtype=int)
         if nchunk == 1:
             work(0, n)
         else:
-            list(self._pool.map(lambda t: work(bounds[t], bounds[t + 1]),
-                                range(nchunk)))
-        if self.label_width == 1:
-            label = label[:, 0]
-        # vectorized normalize (iter_normalize.h: (img - mean) * scale / std)
-        if self._mean is not None:
-            data -= self._mean
-        if self._std is not None:
-            data /= self._std
-        if self._scale != 1.0:
-            data *= self._scale
-        return DataBatch([nd.array(data.astype(self.dtype, copy=False))],
-                         [nd.array(label)], pad=pad,
-                         index=np.asarray(idxs),
-                         provide_data=self.provide_data,
-                         provide_label=self.provide_label)
+            list(self._executor().map(
+                lambda t: work(bounds[t], bounds[t + 1]), range(nchunk)))
+        return data, label
 
     def close(self):
-        self._pool.shutdown(wait=True)
+        if self._dpool is not None:
+            self._dpool.close()
+            self._dpool = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
         with self._readers_lock:
             for rec in self._readers:
                 rec.close()

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import logging
 import time
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -45,56 +46,59 @@ class BaseModule:
               score_end_callback=None, reset=True, epoch=0):
         """reference: base_module.py score"""
         assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
-        eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                 eval_metric=eval_metric, locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(batch_end_params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
-        return eval_metric.get_name_value()
+        with self._adopted_prologue(eval_data):
+            if reset:
+                eval_data.reset()
+            if not isinstance(eval_metric, metric_mod.EvalMetric):
+                eval_metric = metric_mod.create(eval_metric)
+            eval_metric.reset()
+            actual_num_batch = 0
+            for nbatch, eval_batch in enumerate(eval_data):
+                if num_batch is not None and nbatch == num_batch:
+                    break
+                self.forward(eval_batch, is_train=False)
+                self.update_metric(eval_metric, eval_batch.label)
+                if batch_end_callback is not None:
+                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                                     eval_metric=eval_metric, locals=locals())
+                    for callback in _as_list(batch_end_callback):
+                        callback(batch_end_params)
+                actual_num_batch += 1
+            if score_end_callback:
+                params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
+                                       eval_metric=eval_metric, locals=locals())
+                for callback in _as_list(score_end_callback):
+                    callback(params)
+            return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+        with self._adopted_prologue(eval_data):
+            if reset:
+                eval_data.reset()
+            for nbatch, eval_batch in enumerate(eval_data):
+                if num_batch is not None and nbatch == num_batch:
+                    break
+                self.forward(eval_batch, is_train=False)
+                pad = eval_batch.pad
+                outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
+                yield (outputs, nbatch, eval_batch)
 
     def predict(self, eval_data, num_batch=None, merge_batches=True, reset=True,
                 always_output_list=False):
         """reference: base_module.py:243 predict"""
         assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
         output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy() for out in self.get_outputs()]
-            output_list.append(outputs)
+        with self._adopted_prologue(eval_data):
+            if reset:
+                eval_data.reset()
+            for nbatch, eval_batch in enumerate(eval_data):
+                if num_batch is not None and nbatch == num_batch:
+                    break
+                self.forward(eval_batch, is_train=False)
+                pad = eval_batch.pad
+                outputs = [out[0:out.shape[0] - pad].copy() for out in self.get_outputs()]
+                output_list.append(outputs)
         if len(output_list) == 0:
             return output_list
         if merge_batches:
@@ -166,6 +170,10 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        # device-offloaded augmentation: an iterator built with
+        # device_augment=1 yields raw uint8 batches plus the fused
+        # jitted prologue that finishes them ON DEVICE inside fit.step
+        self._install_data_prologue(train_data)
 
         resume_nbatch = -1
         if checkpoint is not None:
@@ -251,6 +259,40 @@ class BaseModule:
         if checkpoint is not None:
             # land queued async snapshots before the process can exit
             checkpoint.flush()
+
+    @contextmanager
+    def _adopted_prologue(self, data_iter):
+        """Adopt ``data_iter``'s device-side input prologue for one
+        eval/predict pass, restoring whatever was installed before
+        (fit's training prologue, possibly with a different raw
+        pre-crop shape) when the pass ends — the next train epoch's
+        fused step must see the training prologue again."""
+        prev = getattr(self, "_input_prologue", None)
+        self._install_data_prologue(data_iter)
+        try:
+            yield
+        finally:
+            if getattr(self, "_input_prologue", None) is not prev:
+                self.set_input_prologue(prev)
+
+    def _install_data_prologue(self, data_iter):
+        """Adopt the data iterator's device-side input prologue (the
+        fused crop/flip/normalize/mixup of device_augment mode).  A
+        plain iterator installs None — explicitly clearing any prologue
+        a previous fit left behind, so switching back to a host-format
+        iterator never routes its batches through a stale raw-shape
+        check."""
+        prologue = getattr(data_iter, "device_prologue", None)
+        if hasattr(self, "set_input_prologue"):
+            self.set_input_prologue(prologue)
+        elif prologue is not None:
+            # silently dropping the prologue would feed raw uint8 NHWC
+            # batches to an executor bound for the final NCHW shape and
+            # die in an opaque broadcast error far from the cause
+            raise MXNetError(
+                f"{type(self).__name__} does not support device-side "
+                "input augmentation; rebuild the iterator with "
+                "device_augment=0 (host augmentation)")
 
     # ------------------------------------------------------------------
     # Symbol & params (reference: base_module.py:452-545)

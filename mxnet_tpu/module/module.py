@@ -35,6 +35,38 @@ from .base_module import BaseModule, _as_list
 __all__ = ["Module"]
 
 
+class _PrologueCache:
+    """Identity-keyed bounded LRU for per-prologue compiled programs.
+
+    Weak keying cannot reclaim these: each cached program's closure
+    strongly references the prologue fn that keys it, so a weak key
+    would be kept alive by its own value forever.  A small LRU bounds
+    the footprint instead — a job constructing iterators (and thus
+    fresh prologue fns) without end evicts the oldest compiled program
+    rather than leaking one per iterator; at worst a swap back to an
+    evicted prologue re-traces."""
+
+    _CAP = 4
+
+    def __init__(self):
+        from collections import OrderedDict
+        self._d = OrderedDict()
+
+    def get(self, key, default=None):
+        d = self._d
+        if key in d:
+            d.move_to_end(key)
+            return d[key]
+        return default
+
+    def put(self, key, value):
+        d = self._d
+        d[key] = value
+        d.move_to_end(key)
+        while len(d) > self._CAP:
+            d.popitem(last=False)
+
+
 def _buffer_ids(*trees):
     """Set of id()s of every jax.Array leaf in the given pytrees."""
     import jax
@@ -142,6 +174,19 @@ class Module(BaseModule):
         self._pending_batch = None
         self._step_count = 0
         self._flushed_backward = False
+        # device-side input prologue (io_pool.make_device_prologue):
+        # raw uint8 batches are augmented/normalized INSIDE the fused
+        # step under the per-step PRNG key; installed by fit/score from
+        # the data iterator's device_prologue
+        self._input_prologue = None
+        # bounded LRU (see _PrologueCache) so a job constructing eval
+        # iterators forever cannot leak one compiled executable per
+        # iterator's prologue fn
+        self._prologue_host_cache = _PrologueCache()
+        # jitted step per installed prologue (None = prologue-free):
+        # score()'s per-epoch install/restore swap must not re-trace
+        # the fused program every epoch
+        self._fused_step_by_prologue = _PrologueCache()
         # mesh data/tensor parallelism (mxnet_tpu.parallel): activated by
         # a multi-context list at bind or kvstore='tpu' at init_optimizer
         self._mesh_plan = None
@@ -550,6 +595,63 @@ class Module(BaseModule):
         self._mesh_plan = plan
         self._apply_mesh_plan()
 
+    def set_input_prologue(self, fn):
+        """Install a device-side input prologue: a jax-traceable
+        ``fn(inputs, rng, train) -> inputs`` applied to the batch at
+        the START of the (fused) training step — the landing point for
+        ``ImageRecordIter(device_augment=1)``'s crop/flip/normalize/
+        mixup.  The prologue's randomness derives from the same
+        device-resident per-step key as dropout, so checkpoint resume
+        replays the augmentation stream bit-exactly.  Non-fused paths
+        (eval, monitored runs, plain-path flushes) apply it eagerly via
+        a cached jit."""
+        if fn is self._input_prologue:
+            return
+        if fn is not None and self._mesh_plan is not None \
+                and self._mesh_plan.spans_processes:
+            raise MXNetError(
+                "device-side input augmentation is not yet supported on "
+                "process-spanning meshes; keep the decode pool "
+                "(workers=) with host augmentation (device_augment=0)")
+        if self._fused_step is not None:
+            self._fused_step_by_prologue.put(self._input_prologue,
+                                             self._fused_step)
+        self._input_prologue = fn
+        if self._fused_step is not None:
+            # swap in the step program built around this prologue (or
+            # build it once); the optimizer state and step counter
+            # carry over untouched
+            cached = self._fused_step_by_prologue.get(fn)
+            self._fused_step = (cached if cached is not None
+                                else self._build_fused_step())
+
+    def _apply_prologue_host(self, kwargs, is_train):
+        """Eagerly apply the input prologue for the non-fused paths.
+        Train-mode randomness here comes from the module PRNG stream
+        (the bit-exact-resume guarantee holds on the fused path, where
+        the prologue runs under the checkpointed per-step key)."""
+        import jax
+
+        from .. import random as _random
+        from ..ndarray import NDArray as _ND
+
+        flag = bool(is_train)
+        pro = self._input_prologue
+        per_pro = self._prologue_host_cache.get(pro)
+        if per_pro is None:
+            per_pro = {}
+            self._prologue_host_cache.put(pro, per_pro)
+        fn = per_pro.get(flag)
+        if fn is None:
+            fn = jax.jit(lambda inputs, rng: pro(inputs, rng, flag))
+            per_pro[flag] = fn
+        inputs = {k: (v._data if isinstance(v, _ND) else np.asarray(v))
+                  for k, v in kwargs.items()}
+        rng = (_random.next_key() if flag
+               else np.zeros(2, np.uint32))  # eval branches draw nothing
+        out = fn(inputs, rng)
+        return {k: _ND(v, self._context[0]) for k, v in out.items()}
+
     def borrow_optimizer(self, shared_module):
         """Share one optimizer across modules — the BucketingModule
         mechanism (reference: module.py borrow_optimizer)."""
@@ -604,6 +706,12 @@ class Module(BaseModule):
         if self._label_names and data_batch.label:
             for name, arr in zip(self._label_names, data_batch.label):
                 kwargs[name] = arr
+        if self._input_prologue is not None and \
+                not (is_train and self._fused_ready()):
+            # non-fused consumption (eval/score/predict, monitored runs):
+            # the raw batch must become final-shaped before it reaches
+            # the executor's arg buffers
+            kwargs = self._apply_prologue_host(kwargs, is_train)
         plan = self._mesh_plan
         if plan is not None and plan.spans_processes:
             # each process supplies its host-local batch; stage it as
@@ -644,6 +752,8 @@ class Module(BaseModule):
         if self._pending_batch is not None:
             kwargs = self._pending_batch
             self._pending_batch = None
+            if self._input_prologue is not None:
+                kwargs = self._apply_prologue_host(kwargs, True)
             self._exec.forward(is_train=True, **kwargs)
 
     def update(self):
@@ -701,11 +811,22 @@ class Module(BaseModule):
         graph_fn = self._exec._graph_fn
         do_mirror = self._exec._do_mirror
         update = self._make_param_update()
+        prologue = self._input_prologue
 
         def step(params, fixed, aux, states, inputs, key, lr, t):
             # per-step PRNG derived on device from the base key + int32
             # step counter — no per-step host→device key transfer
             rng = jax.random.fold_in(key, t)
+            if prologue is not None:
+                # device-side input augmentation fused into the step.
+                # Its key folds the BASE key with -1-t: disjoint from
+                # every graph op key (executor folds rng with dense
+                # node indices >= 0) and from every step key (t >= 0),
+                # so the dropout stream stays identical to a
+                # prologue-free run, and the checkpointed (key, t) pair
+                # makes the augmentation replay bit-exactly on resume
+                inputs = prologue(inputs, jax.random.fold_in(key, -1 - t),
+                                  True)
 
             def f(p):
                 full = dict(inputs)
@@ -1017,6 +1138,24 @@ class Module(BaseModule):
         inputs = {}
         dev = self._context[0].jax_device()
         for k, v in self._pending_batch.items():
+            if self._input_prologue is not None:
+                # raw wire-format batch (e.g. uint8 NHWC): its shape
+                # does not match the executor's arg buffer — stage it
+                # straight to the device untouched; the prologue inside
+                # the step turns it into the bound shape/dtype.  The
+                # uint8 transfer is the 4x H2D cut; stage_array counts
+                # the real bytes for io.h2d_bytes
+                from ..io import stage_array
+                raw = v._data if isinstance(v, NDArray) else np.asarray(v)
+                if self._mesh_plan is not None:
+                    # place() takes the (possibly already device-
+                    # resident) array as-is: a staged batch resharded
+                    # device-to-device, never pulled back to host
+                    sh = self._mesh_plan.input_sharding(np.ndim(raw))
+                    inputs[k] = self._mesh_plan.place(raw, sh)
+                else:
+                    inputs[k] = stage_array(raw, dev)
+                continue
             arr = self._exec.arg_dict[k]
             if isinstance(v, NDArray):
                 if arr._sharding is not None:
@@ -1081,6 +1220,8 @@ class Module(BaseModule):
             # (same dropout masks, aux updates applied exactly once)
             kwargs = self._pending_batch
             self._pending_batch = None
+            if self._input_prologue is not None:
+                kwargs = self._apply_prologue_host(kwargs, True)
             self._exec.forward(is_train=True, **kwargs)
             if all(r in ("write", "null")
                    for r in self._exec.grad_req.values()):

@@ -27,3 +27,11 @@ if "jax" in sys.modules and os.environ.get("MXNET_TEST_TPU", "0") != "1":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / long-running tests excluded from the "
+        "tier-1 `-m 'not slow'` gate (decode-pool fan-out, kill-and-"
+        "resume subprocess drills)")

@@ -1,0 +1,114 @@
+"""Subprocess worker for the pool-mode kill-and-resume test.
+
+Trains a tiny conv net on packed JPEG RecordIO through the FULL
+tentpole path — ``ImageRecordIter(workers=2, device_augment=1)`` (a
+2-process decode pool feeding raw uint8 batches to the fused device
+prologue) — with a CheckpointManager attached.  The test harness runs
+it as a subprocess, kills it (kill -9 via the MXNET_CKPT_CRASH hook or
+externally), reruns with ``resume='auto'``, and asserts the final
+weights bit-match an uninterrupted run: the proof that the exact-resume
+contract survives worker processes and device-side augmentation."""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+N_IMAGES = 48
+BATCH = 8
+CLASSES = 4
+HW = 40          # packed JPEG size; decoded+resized to 36 (pre) -> 32 (crop)
+DATA_SHAPE = (3, 32, 32)
+
+
+def pack_dataset(path):
+    import cv2
+
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    rng = np.random.RandomState(0)
+    for i in range(N_IMAGES):
+        img = (rng.rand(HW, HW, 3) * 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % CLASSES), i, 0), buf.tobytes()))
+    rec.close()
+
+
+def build_sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3),
+                             stride=(2, 2), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def train(rec_path, ckpt_dir=None, num_epoch=2, every_n=2, workers=2,
+          sleep=0.0, progress=False):
+    mx.random.seed(11)
+    np.random.seed(11)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec_path + ".rec", path_imgidx=rec_path + ".idx",
+        data_shape=DATA_SHAPE, batch_size=BATCH, shuffle=True, seed=7,
+        rand_crop=True, rand_mirror=True, workers=workers,
+        device_augment=1)
+    mod = mx.mod.Module(build_sym(), context=mx.cpu())
+    mgr = None
+    if ckpt_dir is not None:
+        mgr = mx.CheckpointManager(ckpt_dir, every_n_steps=every_n,
+                                   async_save=True, keep=10)
+    cb = None
+    if sleep > 0 or progress:
+        def cb(param):
+            if progress:
+                print(f"BATCH {param.nbatch}", flush=True)
+            if sleep > 0:
+                time.sleep(sleep)
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(rnd_type="gaussian"),
+            eval_metric="acc", checkpoint=mgr,
+            resume="auto" if mgr is not None else None,
+            batch_end_callback=cb)
+    if mgr is not None:
+        mgr.close()
+    it.close()
+    args_, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args_.items()}
+
+
+def main():
+    import logging
+
+    logging.basicConfig(level=logging.INFO)  # surface "resuming from"
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rec", required=True)
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--every-n", type=int, default=2)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--sleep", type=float, default=0.0)
+    ap.add_argument("--progress", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    if not os.path.isfile(args.rec + ".rec"):
+        pack_dataset(args.rec)
+    params = train(args.rec, args.ckpt_dir, num_epoch=args.epochs,
+                   every_n=args.every_n, workers=args.workers,
+                   sleep=args.sleep, progress=args.progress)
+    if args.out:
+        np.savez(args.out, **params)
+    print("io pool ckpt worker done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

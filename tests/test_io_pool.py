@@ -1,0 +1,770 @@
+"""Parallel data-plane tests: decode pool, shared-memory ring, device
+augmentation, and the exact-resume contract across worker processes.
+
+Multi-process tests are marked ``slow`` (excluded from the tier-1
+``-m 'not slow'`` gate) and skip on single-core hosts."""
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io_pool, recordio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+multiproc = pytest.mark.skipif(
+    (os.cpu_count() or 1) < 2,
+    reason="decode-pool tests need >= 2 host cores")
+
+
+def _pack(path, n=40, hw=40, classes=7):
+    import cv2
+
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = (rng.rand(hw, hw, 3) * 255).astype(np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        assert ok
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % classes), i, 0), buf.tobytes()))
+    rec.close()
+
+
+@pytest.fixture(scope="module")
+def rec_path():
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "d")
+        _pack(path)
+        yield path
+
+
+def _make_iter(rec_path, **kw):
+    base = dict(path_imgrec=rec_path + ".rec", path_imgidx=rec_path + ".idx",
+                data_shape=(3, 32, 32), batch_size=8, rand_crop=True,
+                rand_mirror=True, shuffle=True, seed=5)
+    base.update(kw)
+    return mx.io.ImageRecordIter(**base)
+
+
+def _drain(it):
+    return [(b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy(), b.pad)
+            for b in it]
+
+
+# ---------------------------------------------------------------------------
+# env/config validation — loud at construction (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_env_validation_garbage_raises(rec_path, monkeypatch):
+    monkeypatch.setenv("MXNET_IO_WORKERS", "many")
+    with pytest.raises(mx.MXNetError, match="MXNET_IO_WORKERS"):
+        _make_iter(rec_path)
+    monkeypatch.delenv("MXNET_IO_WORKERS")
+
+    monkeypatch.setenv("MXNET_IO_RING_SLOTS", "1")
+    with pytest.raises(mx.MXNetError, match="RING_SLOTS"):
+        _make_iter(rec_path, workers=0)
+    monkeypatch.setenv("MXNET_IO_RING_SLOTS", "two")
+    with pytest.raises(mx.MXNetError, match="RING_SLOTS"):
+        _make_iter(rec_path, workers=0)
+    monkeypatch.delenv("MXNET_IO_RING_SLOTS")
+
+    monkeypatch.setenv("MXNET_IO_DEVICE_AUGMENT", "2")
+    with pytest.raises(mx.MXNetError, match="DEVICE_AUGMENT"):
+        _make_iter(rec_path)
+    monkeypatch.setenv("MXNET_IO_DEVICE_AUGMENT", "yes")
+    with pytest.raises(mx.MXNetError, match="DEVICE_AUGMENT"):
+        _make_iter(rec_path)
+
+
+def test_bad_kwargs_raise(rec_path):
+    with pytest.raises(mx.MXNetError, match="workers"):
+        _make_iter(rec_path, workers=-5)
+    with pytest.raises(mx.MXNetError, match="ring_slots"):
+        _make_iter(rec_path, workers=0, ring_slots=1)
+    with pytest.raises(mx.MXNetError, match="mixup"):
+        _make_iter(rec_path, workers=0, mixup_alpha=0.2)  # needs device aug
+    with pytest.raises(mx.MXNetError, match="mixup_alpha"):
+        _make_iter(rec_path, workers=0, device_augment=1, mixup_alpha=-1)
+    # explicit args get the same loud 0/1 validation as the env var
+    # (a CLI typo like --device-augment 10 must not silently opt in)
+    with pytest.raises(mx.MXNetError, match="device_augment"):
+        _make_iter(rec_path, workers=0, device_augment=2)
+    with pytest.raises(mx.MXNetError, match="device_augment"):
+        _make_iter(rec_path, workers=0, device_augment="yes")
+    # host-only augmentations cannot move on device: refuse, don't drop
+    with pytest.raises(mx.MXNetError, match="max_rotate_angle"):
+        _make_iter(rec_path, workers=0, device_augment=1,
+                   max_rotate_angle=10)
+    with pytest.raises(mx.MXNetError, match="resize"):
+        _make_iter(rec_path, workers=0, device_augment=1, resize=16)
+
+
+def test_resolvers(monkeypatch):
+    assert io_pool.resolve_workers(0) == 0
+    assert io_pool.resolve_workers(3) == 3
+    auto = io_pool.resolve_workers("auto")
+    assert 1 <= auto <= 8
+    # an explicitly set env var wins over 'auto', including 0
+    monkeypatch.setenv("MXNET_IO_WORKERS", "0")
+    assert io_pool.resolve_workers("auto") == 0
+    monkeypatch.setenv("MXNET_IO_WORKERS", "3")
+    assert io_pool.resolve_workers("auto") == 3
+    monkeypatch.delenv("MXNET_IO_WORKERS")
+    assert io_pool.resolve_ring_slots(None, 2) == 6  # 2*workers + 2
+    assert io_pool.resolve_ring_slots(4, 1) == 4
+    assert io_pool.epoch_num_batches(10, 4, True) == 3
+    assert io_pool.epoch_num_batches(10, 4, False) == 2
+    idxs = io_pool.batch_indices(np.arange(10), 2, 4, 10)
+    np.testing.assert_array_equal(idxs, [8, 9, 0, 1])  # modular wrap
+
+
+# ---------------------------------------------------------------------------
+# device-augment raw path + prologue numerics (tier-1, workers=0)
+# ---------------------------------------------------------------------------
+
+def test_device_augment_raw_batches_and_eval_prologue(rec_path):
+    it = _make_iter(rec_path, workers=0, device_augment=1)
+    b = next(it)
+    raw = b.data[0]
+    assert raw.dtype == np.uint8
+    assert raw.shape == (8, 36, 36, 3)  # 32 * 8/7 jitter margin
+    (desc,) = it.raw_provide_data
+    assert tuple(desc.shape) == (8, 36, 36, 3) and desc.dtype == np.uint8
+    (final,) = it.provide_data  # what the module binds against
+    assert tuple(final.shape) == (8, 3, 32, 32)
+
+    import jax
+
+    pro = it.device_prologue
+    assert pro is not None
+    out = pro({"data": raw._data}, jax.random.PRNGKey(0), False)
+    assert out["data"].shape == (8, 3, 32, 32)
+    out2 = pro({"data": raw._data}, jax.random.PRNGKey(9), False)
+    # eval path is deterministic: center crop, no flip — key-independent
+    np.testing.assert_array_equal(np.asarray(out["data"], np.float32),
+                                  np.asarray(out2["data"], np.float32))
+    # train path actually randomizes
+    t1 = pro({"data": raw._data}, jax.random.PRNGKey(0), True)
+    t2 = pro({"data": raw._data}, jax.random.PRNGKey(9), True)
+    assert not np.array_equal(np.asarray(t1["data"], np.float32),
+                              np.asarray(t2["data"], np.float32))
+    it.close()
+
+
+def test_device_prologue_matches_host_normalize(rec_path):
+    """With no crop/flip, the device prologue must reproduce the host
+    pipeline's (img - mean) / std * scale numerics exactly."""
+    import jax
+
+    norm = dict(mean_r=120.0, mean_g=110.0, mean_b=100.0,
+                std_r=60.0, std_g=61.0, std_b=62.0, scale=1 / 255.0)
+    common = dict(data_shape=(3, 40, 40), rand_crop=False,
+                  rand_mirror=False, shuffle=False)
+    host = _make_iter(rec_path, workers=0, device_augment=0,
+                      **common, **norm)
+    dev = _make_iter(rec_path, workers=0, device_augment=1,
+                     **common, **norm)
+    hb = next(host).data[0].asnumpy()
+    rawb = next(dev)
+    out = dev.device_prologue({"data": rawb.data[0]._data},
+                              jax.random.PRNGKey(0), False)
+    np.testing.assert_allclose(np.asarray(out["data"], np.float32), hb,
+                               rtol=1e-6, atol=1e-6)
+    host.close()
+    dev.close()
+
+
+def test_prefetching_iter_forwards_prologue(rec_path):
+    inner = _make_iter(rec_path, workers=0, device_augment=1)
+    wrapped = mx.io.PrefetchingIter(inner)
+    assert wrapped.device_prologue is inner.device_prologue
+    plain = mx.io.PrefetchingIter(
+        mx.io.NDArrayIter(np.zeros((8, 4), np.float32), np.zeros(8),
+                          batch_size=4))
+    assert plain.device_prologue is None
+    wrapped.close()
+    plain.close()
+    inner.close()
+
+
+def test_prefetching_multi_iter_rejects_prologue(rec_path):
+    """A multi-iterator PrefetchingIter cannot carry a per-module
+    device prologue: combining a device_augment iterator must raise,
+    not silently drop the prologue."""
+    raw = _make_iter(rec_path, workers=0, device_augment=1)
+    other = mx.io.NDArrayIter(np.zeros((40, 4), np.float32), np.zeros(40),
+                              batch_size=8)
+    multi = mx.io.PrefetchingIter(
+        [raw, other], rename_data=[{"data": "d0"}, {"data": "d1"}],
+        rename_label=[{"softmax_label": "l0"}, {"softmax_label": "l1"}])
+    with pytest.raises(mx.MXNetError, match="device_augment"):
+        multi.device_prologue
+    multi.close()
+    raw.close()
+
+
+def test_device_augment_resize_preserves_aspect(tmp_path):
+    """`resize=` under device_augment must keep the legacy ResizeAug
+    short-edge semantics (aspect-preserving cover-resize + center crop
+    into the fixed ring window), never a warping square resize."""
+    import cv2
+
+    path = str(tmp_path / "rect")
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    rng = np.random.RandomState(1)
+    src = (rng.rand(30, 60, 3) * 255).astype(np.uint8)  # 2:1 landscape
+    ok, buf = cv2.imencode(".png", src)  # lossless: exact reference math
+    assert ok
+    for i in range(8):
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, 0.0, i, 0), buf.tobytes()))
+    rec.close()
+
+    it = mx.io.ImageRecordIter(
+        path_imgrec=path + ".rec", path_imgidx=path + ".idx",
+        data_shape=(3, 32, 32), batch_size=8, resize=36, shuffle=False,
+        workers=0, device_augment=1)
+    got = next(it).data[0].asnumpy()[0]
+    it.close()
+
+    rgb = src[:, :, ::-1]
+    ref = cv2.resize(rgb, (72, 36), interpolation=cv2.INTER_LINEAR)
+    ref = ref[:, (72 - 36) // 2:(72 - 36) // 2 + 36]  # center 36x36
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_mean_image_computed_once(rec_path, tmp_path):
+    """The mean pass runs once in the parent; later consumers (and
+    forked pool workers) reuse the cached array instead of re-reading
+    or recomputing."""
+    mean_path = str(tmp_path / "mean.bin")
+    it1 = _make_iter(rec_path, workers=0, mean_img=mean_path)
+    assert os.path.isfile(mean_path)
+    os.unlink(mean_path)  # a re-read or recompute would now fail/rewrite
+    it2 = _make_iter(rec_path, workers=0, mean_img=mean_path)
+    assert not os.path.isfile(mean_path)  # served from the process cache
+    np.testing.assert_array_equal(it1._mean, it2._mean)
+    it1.close()
+    it2.close()
+
+
+def test_mean_image_in_device_augment_mode(rec_path, tmp_path):
+    """Mean computation must work with the empty host augmenter list of
+    device_augment mode: accumulate over the fixed-resize + center-crop
+    window (records are 40x40, data_shape 32x32 — a naive decode would
+    shape-mismatch the accumulator)."""
+    mean_path = str(tmp_path / "mean_dev.bin")
+    it = _make_iter(rec_path, workers=0, device_augment=1,
+                    mean_img=mean_path)
+    assert it._mean.shape == (3, 32, 32)
+    assert os.path.isfile(mean_path)
+    assert 0.0 < float(it._mean.mean()) < 255.0
+    it.close()
+
+
+def test_score_restores_training_prologue(rec_path):
+    """fit with a device-augment train iter AND a device-augment eval
+    iter of a different raw pre-shape: score() adopts the eval prologue
+    for its pass only, and the next train epoch's fused step must see
+    the train prologue (raw 36x36 train batches vs 40x40 eval batches
+    would otherwise shape-clash, or silently lose augmentation)."""
+    train_it = _make_iter(rec_path, workers=0, device_augment=1)  # pre 36x36
+    val_it = _make_iter(rec_path, workers=0, device_augment=1,
+                        rand_crop=False, rand_mirror=False,
+                        data_shape=(3, 40, 40), shuffle=False)  # pre 40x40
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Pooling(data, kernel=(8, 8), stride=(8, 8),
+                         pool_type="avg")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=7, name="fc")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(train_it, eval_data=None, num_epoch=1, optimizer="sgd",
+            initializer=mx.initializer.Xavier(), eval_metric="acc")
+    assert mod._input_prologue is train_it.device_prologue
+
+    mod2 = mx.mod.Module(sym, context=mx.cpu())
+    mod2.bind(data_shapes=val_it.provide_data,
+              label_shapes=val_it.provide_label, for_training=True)
+    mod2.init_params(mx.initializer.Xavier())
+    mod2.init_optimizer()
+    mod2.set_input_prologue(val_it.device_prologue)
+    prev = mod2._input_prologue
+    other = _make_iter(rec_path, workers=0, device_augment=1,
+                       rand_crop=False, rand_mirror=False,
+                       data_shape=(3, 40, 40), shuffle=False)
+    mod2.score(other, "acc")
+    assert mod2._input_prologue is prev  # restored after the pass
+    for it in (train_it, val_it, other):
+        it.close()
+
+
+def test_fit_plain_iter_clears_stale_prologue(rec_path):
+    """fit on a device-augment iterator, then fit the SAME module on a
+    plain final-format iterator of a different shape (force_rebind):
+    the stale prologue must be uninstalled, not left to reject the new
+    batches' shape."""
+    train_it = _make_iter(rec_path, workers=0, device_augment=1)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(data), num_hidden=7,
+                                name="fc")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(train_it, num_epoch=1, optimizer="sgd",
+            initializer=mx.initializer.Xavier(), eval_metric="acc")
+    assert mod._input_prologue is train_it.device_prologue
+
+    rng = np.random.RandomState(3)
+    plain = mx.io.NDArrayIter(rng.rand(24, 5, 6, 6).astype(np.float32),
+                              rng.randint(0, 7, 24).astype(np.float32),
+                              batch_size=8, label_name="softmax_label")
+    mod.fit(plain, num_epoch=1, optimizer="sgd",
+            initializer=mx.initializer.Xavier(), eval_metric="acc",
+            force_rebind=True, force_init=True)
+    assert mod._input_prologue is None
+    train_it.close()
+
+
+def test_fit_with_eval_data_prologue_swap(rec_path):
+    """End-to-end: fit(train device-augment iter, eval_data=different
+    device-augment iter) across 2 epochs — epoch 2 trains fine after
+    score() swapped prologues at the epoch-1 boundary."""
+    train_it = _make_iter(rec_path, workers=0, device_augment=1)
+    val_it = _make_iter(rec_path, workers=0, device_augment=1,
+                        rand_crop=False, rand_mirror=False,
+                        shuffle=False)  # same data_shape, pre 32x32
+    data = mx.sym.Variable("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, num_hidden=7, name="fc")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(train_it, eval_data=val_it, num_epoch=2, optimizer="sgd",
+            initializer=mx.initializer.Xavier(), eval_metric="acc")
+    assert mod._input_prologue is train_it.device_prologue
+    train_it.close()
+    val_it.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-process pool: determinism, resume, self-healing (slow)
+# ---------------------------------------------------------------------------
+
+@multiproc
+@pytest.mark.slow
+def test_pool_matches_single_process_two_epochs(rec_path):
+    it0 = _make_iter(rec_path, workers=0)
+    ref = [_drain(it0)]
+    it0.reset()
+    ref.append(_drain(it0))
+    it0.close()
+
+    it2 = _make_iter(rec_path, workers=2)
+    for epoch_ref in ref:
+        got = _drain(it2)
+        assert len(got) == len(epoch_ref) == 5
+        for (d1, l1, p1), (d2, l2, p2) in zip(epoch_ref, got):
+            np.testing.assert_array_equal(d1, d2)
+            np.testing.assert_array_equal(l1, l2)
+            assert p1 == p2
+        it2.reset()
+    it2.close()
+
+
+@multiproc
+@pytest.mark.slow
+def test_pool_state_resume_mid_epoch(rec_path):
+    it = _make_iter(rec_path, workers=2)
+    for _ in range(2):
+        next(it)
+    state = it.state_dict()
+    rest_ref = _drain(it)
+    it.close()
+
+    np.random.seed(999)  # different ambient RNG must not matter
+    it2 = _make_iter(rec_path, workers=2)
+    next(it2)  # move somewhere else first
+    it2.set_state(state)
+    rest = _drain(it2)
+    assert len(rest) == len(rest_ref) == 3
+    for (d1, l1, p1), (d2, l2, p2) in zip(rest_ref, rest):
+        np.testing.assert_array_equal(d1, d2)
+        np.testing.assert_array_equal(l1, l2)
+        assert p1 == p2
+    # the restored epoch RNG stream continues identically
+    it2.reset()
+    n = sum(1 for _ in it2)
+    assert n == 5
+    it2.close()
+
+
+@multiproc
+@pytest.mark.slow
+def test_pool_state_resume_epoch_boundary_rewind(rec_path):
+    """rewind=True (the PrefetchingIter wrapping contract) restores the
+    epoch-level state but positions at the epoch START."""
+    it = _make_iter(rec_path, workers=2)
+    epoch_ref = _drain(it)  # consume the whole epoch
+    state = it.state_dict()
+    it.close()
+
+    it2 = _make_iter(rec_path, workers=2)
+    it2.set_state(state, rewind=True)
+    replay = _drain(it2)
+    assert len(replay) == len(epoch_ref) == 5
+    for (d1, l1, _), (d2, l2, _) in zip(epoch_ref, replay):
+        np.testing.assert_array_equal(d1, d2)
+        np.testing.assert_array_equal(l1, l2)
+    it2.close()
+
+    # non-rewind restore of the same end-of-epoch snapshot: positioned
+    # AT the epoch end, and the next epoch proceeds normally
+    it3 = _make_iter(rec_path, workers=2)
+    it3.set_state(state)
+    assert it3.iter_next() is False
+    it3.reset()
+    assert len(_drain(it3)) == 5
+    it3.close()
+
+
+@multiproc
+@pytest.mark.slow
+def test_pool_through_prefetching_iter_resume(rec_path):
+    """The PR-5 contract end-to-end: PrefetchingIter(pool iter)
+    state_dict/set_state round-trips (workers torn down, order
+    restored, rebuilt + skipped to the consumer position)."""
+    it = mx.io.PrefetchingIter(_make_iter(rec_path, workers=2))
+    consumed = [next(it).data[0].asnumpy().copy() for _ in range(2)]
+    state = it.state_dict()
+    rest_ref = [b.data[0].asnumpy().copy() for b in it]
+    it.close()
+
+    it2 = mx.io.PrefetchingIter(_make_iter(rec_path, workers=2))
+    it2.set_state(state)
+    rest = [b.data[0].asnumpy().copy() for b in it2]
+    assert len(rest) == len(rest_ref) == 3
+    for d1, d2 in zip(rest_ref, rest):
+        np.testing.assert_array_equal(d1, d2)
+    it2.close()
+    del consumed
+
+
+@multiproc
+@pytest.mark.slow
+def test_pool_kill_one_worker_self_heals(rec_path):
+    """SIGKILL one decode worker mid-epoch: the pool rebuilds and the
+    epoch completes with no dropped or duplicated batch."""
+    it0 = _make_iter(rec_path, workers=0)
+    ref = _drain(it0)
+    it0.close()
+
+    # ring_slots=2 keeps producers at most one batch ahead, so the
+    # killed worker is GUARANTEED to still owe a batch (batch 3 can't
+    # be produced until batch 1 is consumed) — the rebuild must fire
+    it = _make_iter(rec_path, workers=2, ring_slots=2)
+    first = next(it)
+    np.testing.assert_array_equal(first.data[0].asnumpy(), ref[0][0])
+    os.kill(it._dpool.worker_pids[1], signal.SIGKILL)
+    rest = _drain(it)
+    assert len(rest) == len(ref) - 1
+    for (d2, l2, p2), (d1, l1, p1) in zip(rest, ref[1:]):
+        np.testing.assert_array_equal(d1, d2)
+        np.testing.assert_array_equal(l1, l2)
+        assert p1 == p2
+    assert it._dpool._rebuilds == 1
+    # the healed pool serves the next epoch too
+    it.reset()
+    assert len(_drain(it)) == len(ref)
+    it.close()
+
+
+@multiproc
+@pytest.mark.slow
+def test_pool_workers_survive_fence_lock_held_at_fork(rec_path, monkeypatch):
+    """A fork taken while another trainer thread sits inside _fence()
+    (e.g. a second pool's PrefetchingIter producer) must not wedge the
+    fresh workers: each child re-creates _FENCE_LOCK instead of
+    inheriting it in the held state."""
+    import threading
+
+    ref0 = _make_iter(rec_path, workers=0, shuffle=False)
+    ref = _drain(ref0)
+    ref0.close()
+
+    # fail fast if the regression returns: wedged workers would trip
+    # the stall watchdog and self-heal via a rebuild, which we detect
+    monkeypatch.setattr(io_pool.DecodePool, "stall_timeout_s", 2.0)
+
+    acquired = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with io_pool._FENCE_LOCK:
+            acquired.set()
+            release.wait(30)
+
+    # the pool forks lazily inside the first next(); release the lock
+    # exactly when the fork is done so it is HELD across every fork
+    orig_spawn = io_pool.DecodePool._spawn
+
+    def spawn_then_release(self):
+        try:
+            return orig_spawn(self)
+        finally:
+            release.set()
+
+    monkeypatch.setattr(io_pool.DecodePool, "_spawn", spawn_then_release)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert acquired.wait(5)
+    it = _make_iter(rec_path, workers=2, shuffle=False)
+    got = _drain(it)  # first next() forks the pool under the held lock
+    release.set()  # in case the pool never spawned (construction raise)
+    t.join(5)
+    assert it._dpool._rebuilds == 0  # no stall-watchdog heal was needed
+    assert len(got) == len(ref)
+    for (d1, l1, p1), (d2, l2, p2) in zip(ref, got):
+        np.testing.assert_array_equal(d1, d2)
+        np.testing.assert_array_equal(l1, l2)
+        assert p1 == p2
+    it.close()
+
+
+@multiproc
+@pytest.mark.slow
+def test_worker_does_not_run_inherited_sigterm_handler(rec_path, tmp_path):
+    """Workers must reset SIGTERM to SIG_DFL: a trainer-installed
+    handler (CheckpointManager's emergency save) run inside a forked
+    decode worker would enter jax and write into the live checkpoint
+    dir.  SIGTERM must simply kill the worker (and the pool heals)."""
+    sentinel = tmp_path / "handler_ran"
+    prev = signal.signal(
+        signal.SIGTERM,
+        lambda *_: sentinel.write_text("from pid %d" % os.getpid()))
+    try:
+        it = _make_iter(rec_path, workers=2, ring_slots=2)
+        next(it)  # pool forked with the handler installed in the parent
+        os.kill(it._dpool.worker_pids[1], signal.SIGTERM)
+        rest = _drain(it)  # self-heal completes the epoch
+        assert len(rest) == 4
+        assert it._dpool._rebuilds == 1
+        it.close()
+        assert not sentinel.exists(), sentinel.read_text()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+@multiproc
+@pytest.mark.slow
+def test_pool_wedged_alive_worker_trips_stall_watchdog(rec_path, tmp_path,
+                                                       monkeypatch):
+    """A worker wedged ALIVE in native code (cv2 spinning on a
+    pathological JPEG) never fails is_alive(): the stall watchdog must
+    rebuild instead of hanging fit.step forever.  The wedge clears
+    after the first attempt (flag file), proving self-heal with no
+    dropped or duplicated batch."""
+    from mxnet_tpu.io_record import ImageRecordIter
+
+    ref0 = _make_iter(rec_path, workers=0, shuffle=False)
+    ref = _drain(ref0)  # before the patch: the parent must not wedge
+    ref0.close()
+
+    orig = ImageRecordIter._decode_batch_into
+    flag = tmp_path / "wedged_once"
+    target = set(range(8, 16))  # batch 1 of the shuffle=False order
+
+    def wedging(self, idxs, epoch, data_out, label_out):
+        if {int(i) for i in np.asarray(idxs)} == target and \
+                not flag.exists():
+            flag.touch()
+            time.sleep(120)  # killed by the rebuild teardown long before
+        return orig(self, idxs, epoch, data_out, label_out)
+
+    # patch the CLASS before the pool forks so workers inherit it
+    monkeypatch.setattr(ImageRecordIter, "_decode_batch_into", wedging)
+    monkeypatch.setattr(io_pool.DecodePool, "stall_timeout_s", 2.0)
+    it = _make_iter(rec_path, workers=2, shuffle=False)
+    got = _drain(it)
+    assert len(got) == len(ref) == 5
+    for (d1, l1, p1), (d2, l2, p2) in zip(ref, got):
+        np.testing.assert_array_equal(d1, d2)
+        np.testing.assert_array_equal(l1, l2)
+        assert p1 == p2
+    assert it._dpool._rebuilds == 1
+    assert flag.exists()
+    it.close()
+
+
+@multiproc
+@pytest.mark.slow
+def test_pool_poisoned_batch_raises_after_capped_rebuilds(rec_path,
+                                                          monkeypatch):
+    """A worker that dies deterministically on the SAME batch (e.g. a
+    corrupt record segfaulting cv2) must fail the epoch loudly after
+    the rebuild cap — not self-heal in an infinite loop."""
+    from mxnet_tpu.io_record import ImageRecordIter
+
+    orig = ImageRecordIter._decode_batch_into
+    target = set(range(8, 16))  # batch 1 of the shuffle=False order
+
+    def poisoned(self, idxs, epoch, data_out, label_out):
+        if {int(i) for i in np.asarray(idxs)} == target:
+            os._exit(17)  # simulate a native decoder crash
+        return orig(self, idxs, epoch, data_out, label_out)
+
+    # patch the CLASS before the pool forks so workers inherit it
+    monkeypatch.setattr(ImageRecordIter, "_decode_batch_into", poisoned)
+    it = _make_iter(rec_path, workers=1, shuffle=False)
+    next(it)  # batch 0 decodes fine
+    dpool = it._dpool
+    with pytest.raises(mx.MXNetError, match="batch 1"):
+        _drain(it)
+    assert dpool._rebuilds >= 3
+    # the fatal error released the fleet and the ring: no surviving
+    # workers left busy-polling, no shm pinned until iterator GC
+    assert dpool._procs == [] and dpool._shm_data is None
+    assert it._dpool is None
+    it.close()
+
+
+def test_prologue_rejected_without_module_support(rec_path):
+    """Module kinds that cannot host the device prologue (no
+    set_input_prologue — e.g. SequentialModule) must refuse a
+    device_augment iterator loudly, not silently feed raw uint8 NHWC
+    batches to a final-shape executor."""
+    from mxnet_tpu.module.base_module import BaseModule
+
+    class Plain(BaseModule):
+        pass
+
+    m = Plain.__new__(Plain)
+    it = _make_iter(rec_path, workers=0, device_augment=1)
+    with pytest.raises(mx.MXNetError, match="device-side"):
+        m._install_data_prologue(it)
+    it.close()
+    # a plain iterator has nothing to drop: stays a no-op
+    plain = mx.io.NDArrayIter(np.zeros((8, 4), np.float32), np.zeros(8),
+                              batch_size=4)
+    m._install_data_prologue(plain)
+
+
+def test_predict_installs_prologue(rec_path):
+    """predict()/iter_predict() on a device-augment iterator must adopt
+    its prologue for the pass (raw uint8 NHWC batches would otherwise
+    hit the executor's final-shape arg buffers) and restore the prior
+    prologue afterwards."""
+    it = _make_iter(rec_path, workers=0, device_augment=1, shuffle=False)
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(mx.sym.Flatten(data), num_hidden=7,
+                                name="fc")
+    sym = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    out = mod.predict(it)
+    assert out.shape == (40, 7)
+    assert mod._input_prologue is None  # restored to the pre-pass state
+    n = sum(1 for _ in mod.iter_predict(it))
+    assert n == 5
+    assert mod._input_prologue is None
+    it.close()
+
+
+@multiproc
+@pytest.mark.slow
+def test_fit_device_augment_bitexact_across_worker_counts(rec_path):
+    """Two full fused-step fits over the pool+device-augment path must
+    produce identical weights for workers=0 and workers=2 — scheduling
+    never leaks into the numerics."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import io_pool_crash_worker as W
+
+    with tempfile.TemporaryDirectory() as td:
+        rec = os.path.join(td, "r")
+        W.pack_dataset(rec)
+        w0 = W.train(rec, ckpt_dir=None, num_epoch=2, workers=0)
+        w2 = W.train(rec, ckpt_dir=None, num_epoch=2, workers=2)
+    assert set(w0) == set(w2)
+    for k in w0:
+        np.testing.assert_array_equal(w0[k], w2[k], err_msg=k)
+
+
+@multiproc
+@pytest.mark.slow
+def test_pool_fit_kill9_and_resume_bitexact(tmp_path):
+    """Acceptance: kill -9 a pool-mode (workers=2, device_augment=1)
+    fit mid-epoch, relaunch with resume='auto' — final weights bit-match
+    an uninterrupted run.  Extends the test_dist kill-and-resume proof
+    across decode worker processes and device-side augmentation."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("MXNET_CKPT_DIR", None)
+    worker = os.path.join(REPO, "tests", "io_pool_crash_worker.py")
+    rec = str(tmp_path / "data")
+
+    # uninterrupted reference
+    d_a, out_a = str(tmp_path / "ckpt_a"), str(tmp_path / "a.npz")
+    r = subprocess.run(
+        [sys.executable, worker, "--rec", rec, "--ckpt-dir", d_a,
+         "--out", out_a, "--every-n", "2"],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    # crash run: SIGKILL the trainer after a few steps (a checkpoint
+    # has committed by then at every_n=2)
+    d_b, out_b = str(tmp_path / "ckpt_b"), str(tmp_path / "b.npz")
+    p = subprocess.Popen(
+        [sys.executable, worker, "--rec", rec, "--ckpt-dir", d_b,
+         "--out", out_b, "--every-n", "2", "--sleep", "0.05",
+         "--progress"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=env)
+    try:
+        deadline = time.time() + 300
+        seen = []
+        while time.time() < deadline:
+            line = p.stdout.readline()
+            if not line:
+                break
+            seen.append(line)
+            if "BATCH 4" in line:
+                break
+        assert any("BATCH 4" in l for l in seen), "".join(seen)
+        p.kill()  # SIGKILL: no cleanup, no emergency save
+        p.wait(timeout=60)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    assert not os.path.exists(out_b)
+
+    from mxnet_tpu import checkpoint as C
+    assert any(i.committed for i in C.list_checkpoints(d_b))
+
+    # resume run: must land on the uninterrupted run's exact weights
+    r = subprocess.run(
+        [sys.executable, worker, "--rec", rec, "--ckpt-dir", d_b,
+         "--out", out_b, "--every-n", "2"],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "resuming from" in (r.stdout + r.stderr)
+
+    ref = dict(np.load(out_a))
+    res = dict(np.load(out_b))
+    assert set(ref) == set(res)
+    for k in ref:
+        np.testing.assert_array_equal(
+            ref[k], res[k],
+            err_msg=f"{k}: resumed weights diverge from uninterrupted run")

@@ -125,6 +125,74 @@ def bench_stages(path, n=512):
             "decode_augment_layout": round(full_rate, 1)}
 
 
+def bench_pool_sweep(path, batch_size=128, epochs=2,
+                     worker_counts=(0, 1, 2, 4)):
+    """Decode-pool worker sweep over the device-augment path.
+
+    Each point drives ``ImageRecordIter(workers=w, device_augment=1)``
+    — raw uint8 NHWC batches out of the shared-memory ring (w>0) or the
+    in-process raw path (w=0, single preprocess thread) — and reports
+    the shared single-line JSON schema: throughput_img_s + per-batch
+    p50/p90/p99 latency.  Near-linear scaling of throughput_img_s in w
+    (up to the host's core count) is the multi-core gate's evidence;
+    on few-core sandboxes the tail of the sweep flattens, so the
+    per-worker rate is reported too."""
+    import mxnet_tpu as mx
+
+    ncpu = os.cpu_count() or 1
+    sweep = {}
+    for w in worker_counts:
+        it = mx.io.ImageRecordIter(
+            path_imgrec=path + ".rec", path_imgidx=path + ".idx",
+            data_shape=(3, 224, 224), batch_size=batch_size,
+            rand_crop=True, rand_mirror=True, shuffle=True,
+            preprocess_threads=1, workers=w, device_augment=1)
+        for b in it:  # warm epoch: page cache, worker spin-up
+            pass
+        lat_ms, n, t_all = [], 0, 0.0
+        for _ in range(epochs):
+            it.reset()
+            t_epoch = time.time()
+            while True:
+                t0 = time.time()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    break
+                lat_ms.append((time.time() - t0) * 1e3)
+                n += b.data[0].shape[0]
+            t_all += time.time() - t_epoch
+        it.close()
+        lat = np.asarray(lat_ms)
+        rate = n / t_all
+        sweep[str(w)] = {
+            "throughput_img_s": round(rate, 1),
+            "p50_ms": round(float(np.percentile(lat, 50)), 2),
+            "p90_ms": round(float(np.percentile(lat, 90)), 2),
+            "p99_ms": round(float(np.percentile(lat, 99)), 2),
+        }
+        log(f"pool sweep workers={w}: {rate:.0f} img/s  "
+            f"p50 {sweep[str(w)]['p50_ms']}ms p99 {sweep[str(w)]['p99_ms']}ms")
+    base1 = sweep.get("1", {}).get("throughput_img_s", 0.0)
+    per_worker = {w: (round(s["throughput_img_s"] / max(int(w), 1), 1))
+                  for w, s in sweep.items() if w != "0"}
+    row = {
+        "metric": "io_pool_worker_sweep",
+        "unit": "img/s",
+        "value": max(s["throughput_img_s"] for s in sweep.values()),
+        "mode": "device_augment (raw uint8 NHWC out of the shm ring)",
+        "sweep": sweep,
+        "per_worker_img_s": per_worker,
+        "host_cores": ncpu,
+        # the multi-core gate (real-data within 2x of synthetic at
+        # host_cores=4, device idle < 20%) extrapolates from these
+        # per-worker rates on real hosts; this sandbox caps the sweep
+        # at its own core count
+        "workers_1_img_s": base1,
+    }
+    return row
+
+
 def main():
     train_rate = float(os.environ.get("BENCH_TRAIN_RATE", "2605"))
     ncpu = os.cpu_count() or 1
@@ -138,6 +206,7 @@ def main():
             if t != threads:
                 r, _ = bench_iter(path, threads=t, epochs=2)
                 sweep[t] = round(r, 1)
+        pool_row = bench_pool_sweep(path)
     feed_ok = best >= train_rate
     # per-core sizing: the 1-thread iterator rate is the per-core
     # capacity (the multi-thread aggregate would undercount cores on
@@ -162,7 +231,18 @@ def main():
     log("feed rate %s training rate (%.0f vs %.0f img/s) on %d host core(s);"
         " ~%d cores would feed the chip"
         % (">=" if feed_ok else "<", best, train_rate, ncpu, cores_needed))
+    # pool-vs-legacy verdict: the ring+device-augment path must beat the
+    # legacy single-thread end-to-end rate even at ONE worker (host
+    # augment tax + f32 conversion deleted)
+    legacy_1t = sweep.get(1) or best
+    pool_row["legacy_single_thread_img_s"] = legacy_1t
+    pool_row["beats_legacy_at_workers_1"] = \
+        bool(pool_row["workers_1_img_s"] > legacy_1t)
+    log("pool workers=1 %s legacy 1-thread (%.0f vs %.0f img/s)"
+        % (">" if pool_row["beats_legacy_at_workers_1"] else "<=",
+           pool_row["workers_1_img_s"], legacy_1t))
     print(json.dumps(result))
+    print(json.dumps(pool_row))
     return result
 
 
@@ -193,11 +273,17 @@ def train_real(n_images=1024, batch=128, epochs=3):
         make_dataset(path, n=n_images)
         threads = int(os.environ.get("BENCH_IO_THREADS",
                                      str(os.cpu_count() or 4)))
+        # BENCH_IO_WORKERS / BENCH_IO_DEVICE_AUGMENT flip this row onto
+        # the decode-pool / device-augment data plane (the synthetic-gap
+        # chase on real multi-core hosts)
+        workers = int(os.environ.get("BENCH_IO_WORKERS", "0"))
+        dev_aug = int(os.environ.get("BENCH_IO_DEVICE_AUGMENT", "0"))
         it = mx.io.ImageRecordIter(
             path_imgrec=path + ".rec", path_imgidx=path + ".idx",
             data_shape=(3, 224, 224), batch_size=batch,
             rand_crop=True, rand_mirror=True, shuffle=True,
-            preprocess_threads=threads)
+            preprocess_threads=threads, workers=workers,
+            device_augment=dev_aug)
         it = mx.io.PrefetchingIter(it)
 
         sym = models.resnet(num_classes=1000, num_layers=50,
@@ -274,6 +360,8 @@ def train_real(n_images=1024, batch=128, epochs=3):
             "batch": batch,
             "n_images": n_images,
             "io_threads": threads,
+            "io_workers": workers,
+            "device_augment": bool(dev_aug),
             "host_cores": os.cpu_count(),
             "device_idle_fraction": (round(idle_frac, 4)
                                      if idle_frac is not None else None),
@@ -305,5 +393,10 @@ def _merge_secondary(row):
 if __name__ == "__main__":
     if "--train" in sys.argv:
         train_real()
+    elif "--sweep" in sys.argv:
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "bench")
+            make_dataset(p, n=int(os.environ.get("BENCH_IO_N", "512")))
+            print(json.dumps(bench_pool_sweep(p)))
     else:
         main()
