@@ -52,7 +52,8 @@ from . import rtc
 from . import predictor
 from .predictor import Predictor
 from . import serving
-from .serving import InferenceEngine
+from .serving import InferenceEngine, DecodeEngine, EngineClosedError
+from . import kv_cache
 from . import sequence
 from . import monitor
 from .monitor import Monitor

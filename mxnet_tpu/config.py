@@ -229,6 +229,40 @@ register_env(
     "'before_commit[:n]' dies after the all-shards barrier, before "
     "rank 0's COMMIT.  Unknown values raise.  NEVER set in production.")
 register_env(
+    "MXNET_SERVING_KV_BLOCK", 16, int,
+    "KV-cache page size in TOKENS for serving.DecodeEngine (default "
+    "16).  Also the attention block size of the decode path: page "
+    "boundaries ARE online-softmax block boundaries, which is what "
+    "makes prefill + incremental decode bit-identical (lax path) to "
+    "the full-sequence forward of transformer_lm(block_size=kv_block)."
+    "  Garbage values raise at engine construction.")
+register_env(
+    "MXNET_SERVING_MAX_STREAMS", 64, int,
+    "Concurrent-stream ceiling of the continuous-batching decode "
+    "scheduler; tops the decode batch-bucket ladder.  Admission "
+    "control may hold requests below it when free cache blocks run "
+    "out.  Garbage values raise at engine construction.")
+register_env(
+    "MXNET_SERVING_DECODE_BUCKETS", None, str,
+    "Decode batch-size ladder as a strictly increasing CSV (e.g. "
+    "'1,2,4,8').  Unset: a doubling ladder up to "
+    "MXNET_SERVING_MAX_STREAMS.  One decode executable is AOT-"
+    "compiled per (batch bucket, cache-blocks bucket) pair, so ladder "
+    "length bounds compile count.  Malformed ladders raise at engine "
+    "construction.")
+register_env(
+    "MXNET_SERVING_CACHE_BUCKETS", None, str,
+    "Cache-length ladder in BLOCKS (block-table width) as a strictly "
+    "increasing CSV.  Unset: a doubling ladder up to "
+    "ceil(max_len / kv_block).  Malformed ladders raise at engine "
+    "construction.")
+register_env(
+    "MXNET_SERVING_PREFILL_BUCKETS", None, str,
+    "Prefill prompt-length ladder in TOKENS (CSV, each a multiple of "
+    "MXNET_SERVING_KV_BLOCK so one block-table width serves each "
+    "bucket).  Unset: kv_block-sized doubling ladder up to max_len.  "
+    "Malformed ladders raise at engine construction.")
+register_env(
     "MXNET_TEST_DEVICE", None, str,
     "Device the test utilities bind to (test_utils.default_context; "
     "the reference's MXNET_TEST_DEVICE).  Unset: the ambient current "

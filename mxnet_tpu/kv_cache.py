@@ -1,0 +1,134 @@
+"""Paged KV-cache bookkeeping for autoregressive serving.
+
+The device side of the paged cache is two pool arrays per layer —
+``k_pool``/``v_pool`` of shape ``(num_blocks, block_tokens, H, D)`` —
+updated functionally inside the decode program (``ops/attention.py``
+``QKVPagedAttentionDecode`` / ``PagedCacheWrite``, donated under jit).
+This module is the HOST side: which pages belong to which stream.
+
+Design (PagedAttention, Kwon et al. SOSP '23):
+
+* device memory is carved into fixed-size **token blocks** (pages);
+  a stream holds ``ceil(tokens / block_tokens)`` of them, so memory
+  scales with tokens actually cached, not ``max_len x max_streams``;
+* the **block table** maps a stream's logical block index to a page
+  id; pages are handed out from a free list in any order, so
+  interleaved alloc/free (churning streams) fragments the *table*,
+  never the memory;
+* **page 0 is reserved scratch**: padded batch slots and padded
+  prompt positions write there, which keeps every scatter in the
+  decode program mask-free — reads of scratch are always masked by
+  the per-stream length.
+
+The allocator is intentionally dumb and exact: a LIFO free list and
+integer arithmetic, no heuristics.  Admission control and preemption
+policy live in :class:`mxnet_tpu.serving.DecodeEngine`; the
+``serving.cache_util`` gauge is maintained here so every alloc/free
+updates it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from . import profiler
+from .base import MXNetError
+
+__all__ = ["BlockAllocator", "blocks_for_tokens", "bucket_ladder"]
+
+SCRATCH_PAGE = 0
+
+
+def blocks_for_tokens(tokens: int, block_tokens: int) -> int:
+    """Pages needed to hold ``tokens`` cache entries."""
+    return -(-int(tokens) // int(block_tokens))
+
+
+def bucket_ladder(max_value: int, base: int = 1) -> List[int]:
+    """Doubling ladder ``base, 2*base, ...`` capped at (and always
+    including) ``max_value`` — the executable-cache bucketing shape
+    used for batch sizes, cache blocks and prefill lengths."""
+    out = []
+    v = max(1, int(base))
+    while v < max_value:
+        out.append(v)
+        v *= 2
+    out.append(int(max_value))
+    return out
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` fixed-size token pages.
+
+    Page 0 is reserved as the shared scratch page and never handed
+    out.  ``alloc`` is all-or-nothing: a request that cannot be fully
+    satisfied takes nothing (the caller decides whether to preempt,
+    queue, or shrink)."""
+
+    def __init__(self, num_blocks: int, block_tokens: int):
+        if num_blocks < 2:
+            raise MXNetError(
+                f"BlockAllocator needs >= 2 blocks (1 scratch + 1 "
+                f"usable); got {num_blocks}")
+        if block_tokens < 1:
+            raise MXNetError(f"bad block_tokens {block_tokens}")
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        # LIFO free list: recently-freed (likely still cache-warm)
+        # pages are reused first
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._owner: Dict[int, object] = {}  # page -> stream tag
+        self._update_gauges()
+
+    # ------------------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (excludes the scratch page)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.capacity - len(self._free)
+
+    def utilization(self) -> float:
+        return self.used_blocks / self.capacity if self.capacity else 0.0
+
+    def can_fit(self, tokens: int) -> bool:
+        return blocks_for_tokens(tokens, self.block_tokens) \
+            <= self.free_blocks
+
+    # ------------------------------------------------------------------
+    def alloc(self, n: int, owner=None) -> Optional[List[int]]:
+        """Take ``n`` pages, or None (and take nothing) if they are
+        not all available."""
+        if n < 0:
+            raise MXNetError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._owner[p] = owner
+        self._update_gauges()
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p == SCRATCH_PAGE:
+                raise MXNetError("attempt to free the scratch page")
+            if p not in self._owner:
+                raise MXNetError(
+                    f"double free / foreign page {p} (owned pages: "
+                    f"{sorted(self._owner)})")
+            del self._owner[p]
+            self._free.append(p)
+        self._update_gauges()
+
+    # ------------------------------------------------------------------
+    def _update_gauges(self):
+        profiler.set_gauge("serving.cache_blocks_used", self.used_blocks)
+        profiler.set_gauge("serving.cache_blocks_free", self.free_blocks)
+        profiler.set_gauge("serving.cache_util", self.utilization())

@@ -14,9 +14,12 @@ from .resnet import get_symbol as resnet
 from .inception_bn import get_symbol as inception_bn
 from .inception_v3 import get_symbol as inception_v3
 from .transformer import get_symbol as transformer_lm
+from .transformer import (transformer_lm_prefill,
+                          transformer_lm_decode)
 
 __all__ = ["mlp", "lenet", "alexnet", "vgg", "resnet", "inception_bn",
-           "inception_v3", "transformer_lm", "get_symbol"]
+           "inception_v3", "transformer_lm", "transformer_lm_prefill",
+           "transformer_lm_decode", "get_symbol"]
 
 _FACTORY = {
     "mlp": mlp,

@@ -96,3 +96,127 @@ def get_symbol(vocab_size=10000, seq_len=128, num_layers=4, num_heads=4,
                d_model=128, **kwargs):
     return transformer_lm(vocab_size, seq_len, num_layers=num_layers,
                           num_heads=num_heads, d_model=d_model, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Decode mode — the serving-side symbols.  Parameter names line up
+# exactly with transformer_lm, so the weights of a trained checkpoint
+# (or a Predictor) bind without renaming.  Both symbols take a
+# ``positions`` (B, S) int input instead of assuming rows 0..S-1, so
+# ONE symbol serves every (batch, length) bucket the engine compiles.
+# ---------------------------------------------------------------------------
+
+
+def _decode_block(x, d_model, num_heads, d_ff, name, kv_block, attend):
+    """One pre-LN transformer block with the attention sublayer
+    replaced by ``attend(qkv) -> (att_out, *cache_outs)``."""
+    h = sym.LayerNorm(x, name=f"{name}_ln1")
+    qkv = sym.FullyConnected(h, num_hidden=3 * d_model, flatten=False,
+                             name=f"{name}_qkv")
+    att, cache_outs = attend(qkv)
+    att = sym.FullyConnected(att, num_hidden=d_model, flatten=False,
+                             name=f"{name}_proj")
+    x = x + att
+    h = sym.LayerNorm(x, name=f"{name}_ln2")
+    h = sym.FullyConnected(h, num_hidden=d_ff, flatten=False,
+                           name=f"{name}_ff1")
+    h = sym.Activation(h, act_type="gelu", name=f"{name}_gelu")
+    h = sym.FullyConnected(h, num_hidden=d_model, flatten=False,
+                           name=f"{name}_ff2")
+    return x + h, cache_outs
+
+
+def _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block, attend_for,
+              vocab_size):
+    """Embedding -> blocks -> ln_f -> head logits, with per-layer
+    attention provided by ``attend_for(layer_idx)``."""
+    d_ff = d_ff or 4 * d_model
+    data = sym.Variable("data")            # (B, S) token ids
+    positions = sym.Variable("positions")  # (B, S) absolute positions
+    x = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
+                      name="tok_embed")
+    pos = sym.Variable("pos_embed_weight")
+    x = x + sym.take(pos, positions, name="pos_lookup")
+    caches = []
+    for i in range(num_layers):
+        x, cache_outs = _decode_block(x, d_model, num_heads, d_ff,
+                                      f"layer{i}", kv_block,
+                                      attend_for(i))
+        caches.extend(cache_outs)
+    x = sym.LayerNorm(x, name="ln_f")
+    logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
+                                name="head")
+    return sym.Group([logits] + caches)
+
+
+def transformer_lm_prefill(vocab_size, num_layers=4, num_heads=4,
+                           d_model=128, d_ff=None, kv_block=16,
+                           paged=True):
+    """Prefill symbol: the full causal forward over a (padded) prompt
+    that ALSO writes each layer's K/V state into the cache.
+
+    Inputs: ``data``/``positions`` (B, T), ``lengths`` (B,) int32
+    prompt lengths, plus — paged — ``block_table`` (B, MB) and
+    per-layer ``layer{i}_kpool``/``layer{i}_vpool`` pools.  Outputs:
+    ``[logits (B, T, vocab)] + [updated caches ...]``.  Attention runs
+    at ``block_size=kv_block`` so the logits are bit-identical to
+    ``transformer_lm(..., block_size=kv_block)`` rows (lax path).
+    """
+    lengths = sym.Variable("lengths")
+
+    def attend_for(i):
+        def attend(qkv):
+            att = sym.QKVSelfAttentionPrefill(
+                qkv, num_heads=num_heads, block_size=kv_block,
+                name=f"layer{i}_attn")
+            out, k, v = att[0], att[1], att[2]
+            if not paged:
+                return out, [k, v]
+            pools = sym.PagedCacheWrite(
+                k, v, sym.Variable(f"layer{i}_kpool"),
+                sym.Variable(f"layer{i}_vpool"),
+                sym.Variable("block_table"), lengths,
+                name=f"layer{i}_cache_write")
+            return out, [pools[0], pools[1]]
+        return attend
+
+    return _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block,
+                     attend_for, vocab_size)
+
+
+def transformer_lm_decode(vocab_size, num_layers=4, num_heads=4,
+                          d_model=128, d_ff=None, kv_block=16,
+                          paged=True):
+    """Decode-mode symbol: ONE token per stream per step against the
+    KV cache.
+
+    Inputs: ``data``/``positions`` (B, 1), ``lengths`` (B,) int32
+    counting the current token, plus — paged — ``block_table`` (B, MB)
+    and per-layer pools, or — contiguous — per-layer
+    ``layer{i}_kcache``/``layer{i}_vcache`` (B, C, H, D).  Outputs:
+    ``[logits (B, 1, vocab)] + [updated caches ...]``; feed the
+    updated caches back in (donate them under jit) for the next step.
+    Prefill + N decode steps is bit-identical (lax path) to the
+    full-sequence forward — the page size is the attention block size.
+    """
+    lengths = sym.Variable("lengths")
+
+    def attend_for(i):
+        def attend(qkv):
+            if paged:
+                att = sym.QKVPagedAttentionDecode(
+                    qkv, sym.Variable(f"layer{i}_kpool"),
+                    sym.Variable(f"layer{i}_vpool"),
+                    sym.Variable("block_table"), lengths,
+                    num_heads=num_heads, name=f"layer{i}_attn")
+            else:
+                att = sym.QKVSelfAttentionDecode(
+                    qkv, sym.Variable(f"layer{i}_kcache"),
+                    sym.Variable(f"layer{i}_vcache"), lengths,
+                    num_heads=num_heads, block_size=kv_block,
+                    name=f"layer{i}_attn")
+            return att[0], [att[1], att[2]]
+        return attend
+
+    return _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block,
+                     attend_for, vocab_size)

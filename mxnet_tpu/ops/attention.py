@@ -46,9 +46,20 @@ def blockwise_attention_partial(q, k, v, causal=False, block_size=512,
 
 
 def _blockwise_attention_partial_lax(q, k, v, causal, block_size,
-                                     kv_offset):
+                                     kv_offset, lengths=None):
     """The pure lax.scan formulation — reference semantics and the
-    remat backward for the Pallas forward."""
+    remat backward for the Pallas forward.
+
+    ``lengths`` (B,) int32, when given, replaces the positional causal
+    mask with a per-stream key-visibility mask ``k_pos < lengths[b]``
+    — the incremental-decode contract where the (single) query sits at
+    absolute position ``lengths[b] - 1`` of a cache padded to Tk.  The
+    block-local arithmetic is UNCHANGED, so with the same ``block_size``
+    a decode step over a padded cache is bit-identical to the matching
+    row of the full-sequence causal forward: shared blocks see the same
+    values and the same effective mask, and a fully-masked trailing
+    block is an exact no-op of the online-softmax merge (alpha == 1,
+    p == 0 contributions)."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
@@ -69,7 +80,10 @@ def _blockwise_attention_partial_lax(q, k, v, causal, block_size,
         k_pos = j * block + jnp.arange(block) + kv_offset
         valid = (j * block + jnp.arange(block)) < Tk  # padding mask
         mask = valid[None, None, None, :]
-        if causal:
+        if lengths is not None:
+            mask = mask & (k_pos[None, None, None, :]
+                           < lengths[:, None, None, None])
+        elif causal:
             mask = mask & (k_pos[None, None, None, :]
                            <= q_pos[None, None, :, None])
         s = jnp.where(mask, s, -jnp.inf)
@@ -260,6 +274,257 @@ def _qkv_attention(op_ctx, attrs, inputs, aux):
                                                block or 512, 0)
     out = normalize_attention_state(o, m, l, qkv.dtype)
     return [jnp.reshape(out, (B, T, H * D))]
+
+
+# ---------------------------------------------------------------------------
+# Incremental decode: prefill K/V exposure, cached single-token decode,
+# and the paged (block-table) cache variant.  Design contract: the KV
+# page size IS the attention block size, so the decode step's online-
+# softmax block partition lines up with the full forward's — shared
+# blocks compute identical floats and trailing fully-masked blocks are
+# exact no-ops, making prefill + N decode steps bit-identical (lax
+# path) to the full-sequence causal forward.  See tests/test_decode.py.
+# ---------------------------------------------------------------------------
+
+
+def decode_attention(q, k_cache, v_cache, lengths, block_size):
+    """One-query-position attention over a padded KV cache.
+
+    q: (B, 1, H, D) — the current token's query, sitting at absolute
+    position ``lengths[b] - 1``; k_cache/v_cache: (B, C, H, D) with
+    positions >= lengths[b] ignored (masked exactly); lengths: (B,)
+    int32 INCLUDING the current token.  Returns (B, 1, H, D).
+    """
+    o, m, l = _blockwise_attention_partial_lax(
+        q, k_cache, v_cache, True, block_size or 512, 0, lengths=lengths)
+    return normalize_attention_state(o, m, l, q.dtype)
+
+
+def _unpack_qkv(qkv, H):
+    B, S, HD3 = qkv.shape
+    _check_qkv_packing(HD3, H, qkv.shape)
+    D = HD3 // (3 * H)
+    q, k, v = (jnp.reshape(x, (B, S, H, D))
+               for x in jnp.split(qkv, 3, axis=-1))
+    return q, k, v, D
+
+
+def cache_update(cache_k, cache_v, k_t, v_t, lengths):
+    """Scatter the current token's K/V into a contiguous (B, C, H, D)
+    cache at position ``lengths - 1``.  Streams with lengths == 0
+    (padded batch slots) write to slot 0 — their cache is dead weight
+    and every read of it is masked."""
+    B = cache_k.shape[0]
+    pos = jnp.maximum(lengths - 1, 0)
+    rows = jnp.arange(B)
+    return (cache_k.at[rows, pos].set(k_t[:, 0].astype(cache_k.dtype)),
+            cache_v.at[rows, pos].set(v_t[:, 0].astype(cache_v.dtype)))
+
+
+def paged_cache_update(k_pool, v_pool, k_t, v_t, block_table, lengths):
+    """Scatter the current token's K/V into the paged pools.
+
+    k_pool/v_pool: (P, KVB, H, D); block_table: (B, MB) int32 page ids;
+    lengths: (B,) including the current token.  Page 0 is the reserved
+    scratch page: inactive streams (lengths == 0) land there, so the
+    scatter needs no masking and never corrupts a live page."""
+    KVB = k_pool.shape[1]
+    pos = jnp.maximum(lengths - 1, 0)
+    B = block_table.shape[0]
+    rows = jnp.arange(B)
+    page = jnp.where(lengths > 0,
+                     block_table[rows, pos // KVB], 0)
+    slot = jnp.where(lengths > 0, pos % KVB, 0)
+    return (k_pool.at[page, slot].set(k_t[:, 0].astype(k_pool.dtype)),
+            v_pool.at[page, slot].set(v_t[:, 0].astype(v_pool.dtype)))
+
+
+def paged_prefill_write(k, v, k_pool, v_pool, block_table, lengths):
+    """Scatter a whole prompt's K/V (B, T, H, D) into the paged pools.
+    Positions >= lengths[b] (prompt padding) are routed to the scratch
+    page 0 instead of being masked out of the scatter."""
+    KVB = k_pool.shape[1]
+    B, T = k.shape[0], k.shape[1]
+    pos = jnp.arange(T)
+    live = pos[None, :] < lengths[:, None]                     # (B, T)
+    page = jnp.where(live,
+                     jnp.take_along_axis(
+                         block_table, pos[None, :] // KVB, axis=1), 0)
+    slot = jnp.where(live, pos[None, :] % KVB, 0)
+    return (k_pool.at[page, slot].set(k.astype(k_pool.dtype)),
+            v_pool.at[page, slot].set(v.astype(v_pool.dtype)))
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_table, lengths):
+    """Gather-by-block-table decode attention (lax fallback).
+
+    Materializes the gathered cache (B, MB*KVB, H, D) and runs the
+    same blockwise body with block == KVB, so the result is
+    bit-identical to the contiguous-cache decode (pages hold the same
+    values; page boundaries ARE block boundaries).  The Pallas kernel
+    (pallas_kernels.paged_attention_decode) gathers page-by-page in
+    VMEM instead and never materializes the full cache.
+    """
+    from . import pallas_kernels as pk
+
+    KVB = k_pool.shape[1]
+    if pk.enabled():
+        out = pk.paged_attention_decode(q[:, 0], k_pool, v_pool,
+                                        block_table, lengths)
+        return out[:, None]
+    B, MB = block_table.shape
+    H, D = k_pool.shape[2], k_pool.shape[3]
+    kg = k_pool[block_table].reshape(B, MB * KVB, H, D)
+    vg = v_pool[block_table].reshape(B, MB * KVB, H, D)
+    return decode_attention(q, kg, vg, lengths, KVB)
+
+
+def _qkv_prefill_infer(attrs, in_shapes):
+    (s,) = in_shapes
+    if s is None:
+        return in_shapes, None, None
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    if len(s) != 3:
+        raise MXNetError(
+            f"QKVSelfAttentionPrefill wants a 3-D qkv "
+            f"(B, T, 3*num_heads*d_head); got {s}")
+    _check_qkv_packing(s[2], H, s)
+    D = s[2] // (3 * H)
+    return in_shapes, [(s[0], s[1], s[2] // 3),
+                       (s[0], s[1], H, D), (s[0], s[1], H, D)], []
+
+
+@register("QKVSelfAttentionPrefill", arg_names=("qkv",),
+          out_names=("output", "key", "value"),
+          infer_shape=_qkv_prefill_infer,
+          doc="Causal self-attention off the fused QKV projection that "
+              "ALSO returns the (B, T, H, D) key/value state for a KV "
+              "cache — the prefill half of incremental decode.  Output "
+              "is bit-identical to QKVSelfAttention at the same "
+              "block_size; attrs: num_heads, block_size")
+def _qkv_attention_prefill(op_ctx, attrs, inputs, aux):
+    (qkv,) = inputs
+    if qkv.ndim != 3:
+        raise MXNetError("QKVSelfAttentionPrefill expects (B, T, 3*H*D)")
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    block = attr_int(attrs.get("block_size", 0), 0)
+    q, k, v, D = _unpack_qkv(qkv, H)
+    B, T = qkv.shape[0], qkv.shape[1]
+    from . import pallas_kernels as pk
+
+    if pk.enabled():
+        out = pk.flash_mha_packed(qkv, H, causal=True, block_size=block)
+        return [out, k, v]
+    o, m, l = _blockwise_attention_partial_lax(q, k, v, True, block or 512,
+                                               0)
+    out = normalize_attention_state(o, m, l, qkv.dtype)
+    return [jnp.reshape(out, (B, T, H * D)), k, v]
+
+
+def _qkv_decode_infer(attrs, in_shapes):
+    qkv, ck, cv, ln = in_shapes
+    if qkv is None or ck is None:
+        return in_shapes, None, None
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    _check_qkv_packing(qkv[2], H, qkv)
+    _check_decode_step_shape("QKVSelfAttentionDecode", qkv)
+    return in_shapes, [(qkv[0], 1, qkv[2] // 3), tuple(ck),
+                       tuple(cv if cv is not None else ck)], []
+
+
+@register("QKVSelfAttentionDecode",
+          arg_names=("qkv", "cache_k", "cache_v", "lengths"),
+          out_names=("output", "new_cache_k", "new_cache_v"),
+          infer_shape=_qkv_decode_infer,
+          doc="One incremental-decode step over a contiguous KV cache: "
+              "qkv (B, 1, 3*H*D) of the current token at position "
+              "lengths-1, cache_k/v (B, C, H, D), lengths (B,) int32 "
+              "counting the current token -> output (B, 1, H*D) plus "
+              "the in-place-updated caches (donate them under jit).  "
+              "block_size must equal the prefill/full-forward block "
+              "size for bit-identical decode; attrs: num_heads, "
+              "block_size")
+def _qkv_attention_decode(op_ctx, attrs, inputs, aux):
+    qkv, cache_k, cache_v, lengths = inputs
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    _check_decode_step_shape("QKVSelfAttentionDecode", qkv.shape)
+    block = attr_int(attrs.get("block_size", 0), 0)
+    q, k_t, v_t, D = _unpack_qkv(qkv, H)
+    lengths = lengths.astype(jnp.int32)
+    new_k, new_v = cache_update(cache_k, cache_v, k_t, v_t, lengths)
+    out = decode_attention(q, new_k, new_v, lengths, block)
+    B = qkv.shape[0]
+    return [jnp.reshape(out, (B, 1, H * D)), new_k, new_v]
+
+
+def _check_decode_step_shape(op_name, qkv_shape):
+    if qkv_shape[1] != 1:
+        raise MXNetError(
+            f"{op_name} feeds ONE query position per step; got qkv "
+            f"{tuple(qkv_shape)} (S = {qkv_shape[1]}) — tokens past "
+            f"the first would be silently dropped, not attended")
+
+
+def _qkv_paged_infer(attrs, in_shapes):
+    qkv, kp, vp, bt, ln = in_shapes
+    if qkv is None or kp is None:
+        return in_shapes, None, None
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    _check_qkv_packing(qkv[2], H, qkv)
+    _check_decode_step_shape("QKVPagedAttentionDecode", qkv)
+    return in_shapes, [(qkv[0], 1, qkv[2] // 3), tuple(kp),
+                       tuple(vp if vp is not None else kp)], []
+
+
+@register("QKVPagedAttentionDecode",
+          arg_names=("qkv", "k_pool", "v_pool", "block_table", "lengths"),
+          out_names=("output", "new_k_pool", "new_v_pool"),
+          infer_shape=_qkv_paged_infer,
+          doc="One incremental-decode step over the PAGED KV cache: "
+              "qkv (B, 1, 3*H*D), k_pool/v_pool (P, KVB, H, D) shared "
+              "page pools, block_table (B, MB) int32 page ids (page 0 "
+              "reserved scratch), lengths (B,) int32 -> output "
+              "(B, 1, H*D) + updated pools (donate under jit).  The "
+              "page size KVB is the attention block size; memory "
+              "scales with pages actually held, not max_len x streams."
+              "  Pallas gather-by-block-table kernel on TPU, lax "
+              "gather fallback elsewhere; attrs: num_heads")
+def _qkv_paged_attention_decode(op_ctx, attrs, inputs, aux):
+    qkv, k_pool, v_pool, block_table, lengths = inputs
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    _check_decode_step_shape("QKVPagedAttentionDecode", qkv.shape)
+    q, k_t, v_t, D = _unpack_qkv(qkv, H)
+    lengths = lengths.astype(jnp.int32)
+    block_table = block_table.astype(jnp.int32)
+    new_kp, new_vp = paged_cache_update(k_pool, v_pool, k_t, v_t,
+                                        block_table, lengths)
+    out = paged_decode_attention(q, new_kp, new_vp, block_table, lengths)
+    B = qkv.shape[0]
+    return [jnp.reshape(out, (B, 1, H * D)), new_kp, new_vp]
+
+
+def _paged_write_infer(attrs, in_shapes):
+    k, v, kp, vp, bt, ln = in_shapes
+    if kp is None:
+        return in_shapes, None, None
+    return in_shapes, [tuple(kp), tuple(vp if vp is not None else kp)], []
+
+
+@register("PagedCacheWrite",
+          arg_names=("key", "value", "k_pool", "v_pool", "block_table",
+                     "lengths"),
+          out_names=("new_k_pool", "new_v_pool"),
+          infer_shape=_paged_write_infer,
+          doc="Scatter a prefilled prompt's (B, T, H, D) key/value "
+              "state into the paged pools through each stream's block "
+              "table; positions >= lengths[b] land on the scratch page "
+              "0.  The prefill half of paged incremental decode.")
+def _paged_cache_write(op_ctx, attrs, inputs, aux):
+    k, v, k_pool, v_pool, block_table, lengths = inputs
+    new_kp, new_vp = paged_prefill_write(
+        k, v, k_pool, v_pool, block_table.astype(jnp.int32),
+        lengths.astype(jnp.int32))
+    return [new_kp, new_vp]
 
 
 @register("DotProductAttention", arg_names=("query", "key", "value"),

@@ -41,12 +41,20 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, get_env
 from . import profiler
 
-__all__ = ["InferenceEngine"]
+__all__ = ["InferenceEngine", "DecodeEngine", "EngineClosedError"]
 
 _DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+
+class EngineClosedError(MXNetError):
+    """Named failure for futures outstanding when an engine shuts down
+    (or when its serving loop dies): raised AT WAIT by every affected
+    future instead of letting callers block forever — the PR-3
+    'failure poisoning raises at wait instead of hanging' convention
+    applied to the serving tier."""
 
 
 class _Request:
@@ -204,6 +212,7 @@ class InferenceEngine:
         self._pipeline_depth = int(pipeline_depth)
         self._inflight: _queue.Queue = _queue.Queue(maxsize=pipeline_depth)
         self._carry: Optional[_Request] = None
+        self._building: Optional[List[_Request]] = None
         self._cache: Dict[int, Any] = {}
         self._lock = threading.Lock()  # stats
         self._compile_lock = threading.Lock()  # one compile per bucket
@@ -450,6 +459,17 @@ class InferenceEngine:
 
     # -- batcher thread: coalesce → pad → stage → dispatch --------------
     def _batch_loop(self):
+        try:
+            self._batch_loop_inner()
+        except BaseException as exc:  # loop died: poison, don't hang
+            # every queued request would otherwise wait forever and
+            # close() would block on a completer that never gets its
+            # sentinel — fail them all with a named error instead
+            self._shutdown(EngineClosedError(
+                f"InferenceEngine batch loop died: {exc!r}"))
+            raise
+
+    def _batch_loop_inner(self):
         while True:
             first = self._carry
             self._carry = None
@@ -459,6 +479,9 @@ class InferenceEngine:
                 self._shutdown()
                 return
             batch = [first]
+            # visible to _shutdown: a loop death mid-coalesce must fail
+            # the requests already popped off the queue too
+            self._building = batch
             total = first.n
             reason = "full" if total >= self._max_batch else "timeout"
             closing = False
@@ -504,6 +527,7 @@ class InferenceEngine:
                 total += req.n
                 if total >= self._max_batch:
                     reason = "full"
+            self._building = None
             try:
                 self._dispatch(batch, total, reason)
             except Exception:  # _dispatch already failed the futures
@@ -512,9 +536,14 @@ class InferenceEngine:
                 self._shutdown()
                 return
 
-    def _shutdown(self):
-        """Fail stragglers that raced close(), then release the
-        completion thread."""
+    def _shutdown(self, exc: Optional[Exception] = None):
+        """Fail stragglers that raced close() (or that a dead batch
+        loop stranded), then release the completion thread."""
+        exc = exc or EngineClosedError("InferenceEngine closed")
+        building, self._building = self._building, None
+        for req in building or ():
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(exc)
         carry = self._carry
         self._carry = None
         while True:
@@ -526,8 +555,7 @@ class InferenceEngine:
                 except _queue.Empty:
                     break
             if req is not None and req.future.set_running_or_notify_cancel():
-                req.future.set_exception(
-                    MXNetError("InferenceEngine closed"))
+                req.future.set_exception(exc)
         self._inflight.put(None)
 
     def _dispatch(self, batch: List[_Request], total: int, reason: str):
@@ -644,3 +672,831 @@ class InferenceEngine:
                 lat_ms = (now - req.t_submit) * 1e3
                 self._metrics.observe("latency_ms", lat_ms)
                 profiler.observe("serving.latency_ms", lat_ms)
+
+
+# ---------------------------------------------------------------------------
+# Autoregressive serving: continuous batching over a paged KV cache.
+# ---------------------------------------------------------------------------
+
+
+def _read_env_int(name, lo=1):
+    """Loud at-construction validation (the checkpoint env-var
+    convention): garbage values raise immediately, naming the
+    variable.  The default comes from the config catalog — the one
+    place it is declared — so ``mx.config.describe`` never documents
+    a default the engine doesn't actually use."""
+    from . import config
+
+    raw = get_env(name, None, str)
+    if raw is None:
+        return config.describe(name).default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise MXNetError(f"{name}={raw!r} is not an integer")
+    if v < lo:
+        raise MXNetError(f"{name}={v} must be >= {lo}")
+    return v
+
+
+def _read_env_buckets(name, default):
+    """CSV bucket ladder: strictly increasing positive ints."""
+    raw = get_env(name, None, str)
+    if raw is None:
+        return default
+    try:
+        vals = [int(x) for x in raw.split(",") if x.strip()]
+    except ValueError:
+        raise MXNetError(f"{name}={raw!r} is not a comma-separated "
+                         f"list of integers")
+    if not vals or any(v < 1 for v in vals) \
+            or any(b <= a for a, b in zip(vals, vals[1:])):
+        raise MXNetError(f"{name}={raw!r} must be a strictly "
+                         f"increasing ladder of positive ints")
+    return vals
+
+
+class _Stream:
+    """One in-flight generation: host-side state the scheduler owns."""
+
+    __slots__ = ("sid", "prompt", "max_new", "temp", "eos", "future",
+                 "seed", "generated", "blocks", "length", "next_token",
+                 "resume", "t_submit", "t_admit")
+
+    def __init__(self, sid, prompt, max_new, temp, eos, future, seed):
+        self.sid = sid
+        self.prompt = prompt          # np.int32 (P,)
+        self.max_new = max_new
+        self.temp = temp
+        self.eos = eos
+        self.future = future
+        self.seed = seed
+        self.generated: List[int] = []
+        self.blocks: List[int] = []   # page ids held (host block table)
+        self.length = 0               # tokens currently cached
+        self.next_token = -1          # sampled, not yet fed
+        self.resume = False           # re-prefill after preemption
+        self.t_submit = time.perf_counter()
+        self.t_admit = 0.0
+
+    def prefill_seq(self) -> np.ndarray:
+        """Token sequence whose K/V the cache must hold before the
+        next decode step: the prompt, plus — after a preemption — all
+        sampled tokens except the pending ``next_token``."""
+        if not self.resume:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt,
+             np.asarray(self.generated[:-1], np.int32)])
+
+    def done(self) -> bool:
+        return (len(self.generated) >= self.max_new
+                or (self.eos is not None and self.generated
+                    and self.generated[-1] == self.eos))
+
+
+class DecodeEngine:
+    """Continuous-batching autoregressive serving over a paged KV cache.
+
+    The iteration-level scheduler (Orca, Yu et al. OSDI '22): sequences
+    join and retire at EVERY decode step, not per request —
+
+    * **prefill** runs the full causal forward over a (bucket-padded)
+      prompt once, writing each layer's K/V into fixed-size cache
+      pages through the stream's block table;
+    * **decode** advances ALL active streams one token per step with a
+      single program: one query position per stream against the paged
+      cache (``QKVPagedAttentionDecode`` — the Pallas
+      gather-by-block-table kernel on TPU), greedy/temperature
+      sampling on device, one (B,) int32 D2H per step;
+    * executables are AOT-compiled per ``(batch bucket, cache-blocks
+      bucket)`` and cached — the ``InferenceEngine`` bucketed-cache
+      pattern — with the pool buffers donated so the cache updates in
+      place on accelerators;
+    * **admission control** is keyed to free cache blocks: a pending
+      request is admitted only when its prompt's pages (plus one block
+      of decode headroom) are free.  When a growing stream finds the
+      pool empty, the YOUNGEST stream is preempted — its pages freed,
+      its progress re-queued for re-prefill (recompute-style
+      preemption; ``serving.preempted`` counts them).
+
+    Decode numerics: prefill + N decode steps is bit-identical (lax
+    path) to the full-sequence causal forward of
+    ``transformer_lm(..., block_size=kv_block)`` — the page size IS
+    the attention block size (see ops/attention.py).
+
+    Parameters
+    ----------
+    params : dict
+        Parameter arrays by training-symbol name (``Module.get_params``
+        arg dict, merged aux, or a ``Predictor``'s weights).
+    vocab_size, num_layers, num_heads, d_model, d_ff : int
+        Architecture of the served ``transformer_lm``.
+    max_len : int, optional
+        Longest prompt+generation a stream may reach.  Default: the
+        ``pos_embed_weight`` row count.
+    kv_block : int
+        Cache page size in tokens (env ``MXNET_SERVING_KV_BLOCK``,
+        default 16).  Also the attention block size.
+    max_streams : int
+        Concurrent-stream ceiling (env ``MXNET_SERVING_MAX_STREAMS``,
+        default 64); the top of the decode batch-bucket ladder.
+    cache_blocks : int, optional
+        Total pool pages (+1 reserved scratch).  Default sizes the
+        pool so every stream can reach ``max_len`` (no preemption);
+        pass something smaller to trade memory for preemptions.
+    decode_buckets, cache_buckets, prefill_buckets
+        Explicit ladders (batch sizes / table widths in blocks /
+        prompt tokens); env ``MXNET_SERVING_DECODE_BUCKETS`` /
+        ``_CACHE_BUCKETS`` / ``_PREFILL_BUCKETS``.  Defaults: doubling
+        ladders.
+    temperature : float
+        Default sampling temperature; 0 = greedy.  Per-request
+        override via ``submit``.
+    """
+
+    def __init__(self, params, *, vocab_size, num_layers, num_heads,
+                 d_model, d_ff=None, max_len=None, kv_block=None,
+                 max_streams=None, cache_blocks=None,
+                 decode_buckets=None, cache_buckets=None,
+                 prefill_buckets=None, temperature=0.0, seed=0,
+                 eos_id=None, ctx=None, donate=None, dtype="float32",
+                 prewarm=False):
+        import jax
+
+        from .kv_cache import BlockAllocator, blocks_for_tokens, \
+            bucket_ladder
+        from .executor import build_graph_fn
+        from .models.transformer import transformer_lm_decode, \
+            transformer_lm_prefill
+
+        self._blocks_for = blocks_for_tokens
+        self._vocab = int(vocab_size)
+        self._L = int(num_layers)
+        self._H = int(num_heads)
+        if d_model % num_heads:
+            raise MXNetError(f"d_model {d_model} % num_heads "
+                             f"{num_heads} != 0")
+        self._D = int(d_model) // int(num_heads)
+
+        self._kv_block = kv_block if kv_block is not None else \
+            _read_env_int("MXNET_SERVING_KV_BLOCK")
+        if int(self._kv_block) < 1:
+            raise MXNetError(f"kv_block {self._kv_block} must be >= 1")
+        self._kv_block = int(self._kv_block)
+        self._max_streams = max_streams if max_streams is not None else \
+            _read_env_int("MXNET_SERVING_MAX_STREAMS")
+        if int(self._max_streams) < 1:
+            raise MXNetError(
+                f"max_streams {self._max_streams} must be >= 1")
+        self._max_streams = int(self._max_streams)
+
+        # -- parameters onto the device ---------------------------------
+        if ctx is None:
+            from .context import current_context
+            ctx = current_context()
+        self._ctx = ctx
+        dev = ctx.jax_device()
+        self._device = dev
+
+        def to_dev(v):
+            arr = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+            return jax.device_put(arr, dev)
+
+        host_params = {k: v for k, v in params.items()}
+        if "pos_embed_weight" not in host_params:
+            raise MXNetError(
+                "params has no 'pos_embed_weight' — DecodeEngine serves "
+                "the transformer_lm family (models/transformer.py)")
+        pos_rows = int(host_params["pos_embed_weight"].shape[0])
+        self._max_len = int(max_len) if max_len is not None else pos_rows
+        if self._max_len > pos_rows:
+            raise MXNetError(
+                f"max_len {self._max_len} exceeds the model's learned "
+                f"positions ({pos_rows} pos_embed_weight rows)")
+
+        self._max_blocks_seq = blocks_for_tokens(self._max_len,
+                                                 self._kv_block)
+        if cache_blocks is None:
+            cache_blocks = 1 + self._max_streams * self._max_blocks_seq
+        if int(cache_blocks) < 2:
+            raise MXNetError(f"cache_blocks {cache_blocks} must be >= 2")
+        self._alloc = BlockAllocator(int(cache_blocks), self._kv_block)
+
+        # -- bucket ladders ---------------------------------------------
+        self._decode_buckets = tuple(
+            decode_buckets if decode_buckets is not None else
+            _read_env_buckets("MXNET_SERVING_DECODE_BUCKETS",
+                              bucket_ladder(self._max_streams)))
+        self._cache_buckets = tuple(
+            cache_buckets if cache_buckets is not None else
+            _read_env_buckets("MXNET_SERVING_CACHE_BUCKETS",
+                              bucket_ladder(self._max_blocks_seq)))
+        pre_default = [b * self._kv_block
+                       for b in bucket_ladder(self._max_blocks_seq)]
+        self._prefill_buckets = tuple(
+            prefill_buckets if prefill_buckets is not None else
+            _read_env_buckets("MXNET_SERVING_PREFILL_BUCKETS",
+                              pre_default))
+        for pb in self._prefill_buckets:
+            if pb % self._kv_block:
+                raise MXNetError(
+                    f"prefill bucket {pb} is not a multiple of "
+                    f"kv_block {self._kv_block} (page-aligned prefill "
+                    f"keeps ONE block table width per bucket)")
+        for lad, nm in ((self._decode_buckets, "decode_buckets"),
+                        (self._cache_buckets, "cache_buckets"),
+                        (self._prefill_buckets, "prefill_buckets")):
+            if any(b <= a for a, b in zip(lad, lad[1:])) or lad[0] < 1:
+                raise MXNetError(f"bad {nm} ladder {lad}")
+        # A ladder that doesn't cover the configured maxima would kill
+        # the serving loop mid-flight (a _bucket miss poisons EVERY
+        # outstanding future) — reject it here instead.
+        if self._decode_buckets[-1] < self._max_streams:
+            raise MXNetError(
+                f"decode_buckets {self._decode_buckets} does not cover "
+                f"max_streams {self._max_streams}")
+        if self._cache_buckets[-1] < self._max_blocks_seq:
+            raise MXNetError(
+                f"cache_buckets {self._cache_buckets} does not cover "
+                f"the {self._max_blocks_seq} pages a max_len "
+                f"({self._max_len}) stream holds")
+
+        # -- graphs + pools ---------------------------------------------
+        kw = dict(vocab_size=vocab_size, num_layers=num_layers,
+                  num_heads=num_heads, d_model=d_model, d_ff=d_ff,
+                  kv_block=self._kv_block, paged=True)
+        dec_sym = transformer_lm_decode(**kw)
+        pre_sym = transformer_lm_prefill(**kw)
+        self._dec_gfn = build_graph_fn(dec_sym)
+        self._pre_gfn = build_graph_fn(pre_sym)
+        feed = {"data", "positions", "lengths", "block_table"}
+        feed |= {f"layer{i}_{t}pool" for i in range(self._L)
+                 for t in "kv"}
+        self._param_names = [n for n in dec_sym.list_arguments()
+                             if n not in feed]
+        missing = [n for n in self._param_names if n not in host_params]
+        if missing:
+            raise MXNetError(f"params missing {missing} for the "
+                             f"decode graph")
+        self._params = {n: to_dev(host_params[n])
+                        for n in self._param_names}
+        self._np_dtype = np.dtype(dtype)
+        pool_shape = (int(cache_blocks), self._kv_block, self._H,
+                      self._D)
+        pool_zero = np.zeros(pool_shape, self._np_dtype)
+        self._pools = tuple(jax.device_put(pool_zero, dev)
+                            for _ in range(2 * self._L))
+        self._pool_bytes = 2 * self._L * int(np.prod(pool_shape)) \
+            * self._np_dtype.itemsize
+        profiler.set_gauge("serving.kv_pool_bytes", self._pool_bytes)
+
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        self._donate = bool(donate)
+        self._base_key = jax.random.PRNGKey(int(seed))
+        self._graph_key = jax.random.PRNGKey(0)
+        self._temperature = float(temperature)
+        self._eos = eos_id
+
+        self._exe_cache: Dict[tuple, Any] = {}
+        self._compile_lock = threading.Lock()
+        self.compiles: Dict[tuple, int] = {}
+        self._metrics = profiler.MetricsRegistry()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: List[_Stream] = []
+        self._active: List[_Stream] = []
+        self._admitting: Optional[_Stream] = None
+        self._accepting = True
+        self._alive = True
+        self._next_sid = 0
+
+        if prewarm:
+            self.warmup()
+
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="mxnet_tpu-serving-decode")
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # client surface
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=32, temperature=None,
+               eos_id=None) -> Future:
+        """Enqueue one generation; the Future resolves to the np.int32
+        array of generated token ids (eos, when hit, is included)."""
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise MXNetError(
+                f"prompt must be a non-empty 1-D token array; got "
+                f"shape {prompt.shape}")
+        prompt = prompt.astype(np.int32)
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise MXNetError(f"max_new_tokens {max_new} must be >= 1")
+        total = prompt.size + max_new
+        if total > self._max_len:
+            raise MXNetError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
+                f"= {total} exceeds max_len {self._max_len}")
+        if prompt.size > self._prefill_buckets[-1]:
+            raise MXNetError(
+                f"prompt of {prompt.size} tokens exceeds the largest "
+                f"prefill bucket {self._prefill_buckets[-1]}")
+        need = self._blocks_for(total, self._kv_block)
+        if need > self._alloc.capacity:
+            raise MXNetError(
+                f"request needs {need} cache blocks but the pool only "
+                f"has {self._alloc.capacity}")
+        temp = self._temperature if temperature is None \
+            else float(temperature)
+        eos = self._eos if eos_id is None else eos_id
+        fut: Future = Future()
+        with self._cond:
+            if not self._accepting:
+                raise EngineClosedError("DecodeEngine is closed")
+            s = _Stream(self._next_sid, prompt, max_new, temp, eos, fut,
+                        seed=self._next_sid + 1)
+            self._next_sid += 1
+            self._pending.append(s)
+            self._cond.notify_all()
+        self._count("requests")
+        return fut
+
+    def generate(self, prompt, max_new_tokens=32, **kw) -> np.ndarray:
+        """Synchronous convenience: ``submit(...).result()``."""
+        return self.submit(prompt, max_new_tokens, **kw).result()
+
+    def warmup(self):
+        """Compile EVERY prefill bucket and every (batch, cache)
+        decode combination now — a lazily-compiled executable inside
+        the serving loop stalls every active stream for the compile
+        (seconds), which is exactly the p99 a decode tier cares
+        about."""
+        for tp in self._prefill_buckets:
+            self._prefill_exe(tp)
+        for bb in self._decode_buckets:
+            for mb in self._cache_buckets:
+                self._decode_exe(bb, mb)
+
+    def _count(self, name, value=1.0):
+        self._metrics.inc(name, value)
+        profiler.inc_counter(f"serving.{name}", value)
+
+    # ------------------------------------------------------------------
+    def reset_stats(self):
+        """Zero the engine-local counters/histograms so the next
+        :meth:`stats` covers only work from this point on (benchmarks
+        isolate sweep points; lifetime percentiles blend loads)."""
+        self._metrics.reset()
+
+    def stats(self) -> dict:
+        summ = self._metrics.summary()
+        c = summ["counters"]
+        out = {k: int(c.get(k, 0)) for k in
+               ("requests", "generations", "tokens", "prefill_tokens",
+                "preempted", "prefills", "steps")}
+        tpt = summ["histograms"].get("time_per_token_ms")
+        out["p50_ms"] = tpt["p50"] if tpt else None
+        out["p90_ms"] = tpt["p90"] if tpt else None
+        out["p99_ms"] = tpt["p99"] if tpt else None
+        ttft = summ["histograms"].get("ttft_ms")
+        out["ttft_p50_ms"] = ttft["p50"] if ttft else None
+        out["tokens_per_s"] = summ["rates"].get("tokens", 0.0)
+        out["cache_util"] = self._alloc.utilization()
+        out["cache_blocks_free"] = self._alloc.free_blocks
+        with self._lock:
+            out["active_streams"] = len(self._active)
+            out["pending"] = len(self._pending)
+        out["compiles"] = {str(k): v for k, v in self.compiles.items()}
+        out["decode_buckets"] = list(self._decode_buckets)
+        out["cache_buckets"] = list(self._cache_buckets)
+        out["prefill_buckets"] = list(self._prefill_buckets)
+        out["kv_block"] = self._kv_block
+        return out
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def close(self, timeout: float = 30.0):
+        """Stop accepting work and fail every outstanding generation
+        with :class:`EngineClosedError` at the next step boundary —
+        in-flight decodes never strand their futures."""
+        with self._cond:
+            if not self._alive:
+                return
+            self._accepting = False
+            self._alive = False
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            # Join timed out mid-step (e.g. a lazy compile): the loop
+            # thread still owns _active and the allocator — failing
+            # outstanding futures here would race it.  Its finally
+            # clause poisons them at the step boundary instead.
+            return
+        self._fail_outstanding(EngineClosedError("DecodeEngine closed"))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close(timeout=1.0)
+        except Exception:
+            pass
+
+    def _fail_outstanding(self, exc):
+        with self._lock:
+            streams = self._pending + self._active
+            # a stream popped for admission but not yet active (its
+            # prefill raised) must not strand its caller
+            if self._admitting is not None:
+                if self._admitting not in streams:
+                    streams.append(self._admitting)
+                self._admitting = None
+            self._pending, self._active = [], []
+        for s in streams:
+            if s.blocks:
+                self._alloc.free(s.blocks)
+                s.blocks = []
+            if s.future.set_running_or_notify_cancel():
+                s.future.set_exception(exc)
+
+    # ------------------------------------------------------------------
+    # executables
+    # ------------------------------------------------------------------
+    def _bucket(self, ladder, n, what):
+        for b in ladder:
+            if b >= n:
+                return b
+        raise MXNetError(f"{what} {n} exceeds ladder {ladder}")
+
+    def _sample(self, logits, temps, seeds, steps):
+        """On-device greedy/temperature sampling, per-stream keyed by
+        (engine seed, stream seed, absolute position) — reproducible
+        whatever batch the stream happens to ride in."""
+        import jax
+        import jax.numpy as jnp
+
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        base = self._base_key
+
+        def one(sd, st, row, tp):
+            key = jax.random.fold_in(jax.random.fold_in(base, sd), st)
+            safe = jnp.where(tp > 0, tp, 1.0)
+            return jax.random.categorical(key, row / safe).astype(
+                jnp.int32)
+
+        sampled = jax.vmap(one)(seeds, steps, logits, temps)
+        return jnp.where(temps > 0, sampled, greedy)
+
+    def _spec_of(self, tree):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), tree)
+
+    def _decode_exe(self, bb: int, mb: int):
+        key = ("decode", bb, mb)
+        exe = self._exe_cache.get(key)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._exe_cache.get(key)
+            if exe is not None:
+                return exe
+            import jax
+
+            gfn, L = self._dec_gfn, self._L
+            gkey = self._graph_key
+
+            def step(params, tokens, positions, lengths, table, temps,
+                     seeds, steps, pools):
+                args = dict(params)
+                args.update(data=tokens, positions=positions,
+                            lengths=lengths, block_table=table)
+                for i in range(L):
+                    args[f"layer{i}_kpool"] = pools[2 * i]
+                    args[f"layer{i}_vpool"] = pools[2 * i + 1]
+                outs, _ = gfn(args, {}, gkey, False)
+                toks = self._sample(outs[0][:, 0, :], temps, seeds,
+                                    steps)
+                return toks, tuple(outs[1:])
+
+            i32 = np.dtype(np.int32)
+            specs = (self._spec_of(self._params),
+                     jax.ShapeDtypeStruct((bb, 1), i32),
+                     jax.ShapeDtypeStruct((bb, 1), i32),
+                     jax.ShapeDtypeStruct((bb,), i32),
+                     jax.ShapeDtypeStruct((bb, mb), i32),
+                     jax.ShapeDtypeStruct((bb,), np.dtype(np.float32)),
+                     jax.ShapeDtypeStruct((bb,), i32),
+                     jax.ShapeDtypeStruct((bb,), i32),
+                     self._spec_of(self._pools))
+            with profiler.scope(f"serving.compile.decode.b{bb}x{mb}",
+                                "serving", args={"batch": bb,
+                                                 "blocks": mb}):
+                jitted = jax.jit(
+                    step,
+                    donate_argnums=(8,) if self._donate else ())
+                exe = jitted.lower(*specs).compile()
+            self._exe_cache[key] = exe
+            self.compiles[key] = self.compiles.get(key, 0) + 1
+            return exe
+
+    def _prefill_exe(self, tp: int):
+        key = ("prefill", tp)
+        exe = self._exe_cache.get(key)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._exe_cache.get(key)
+            if exe is not None:
+                return exe
+            import jax
+            import jax.numpy as jnp
+
+            gfn, L = self._pre_gfn, self._L
+            gkey = self._graph_key
+            mb = tp // self._kv_block
+
+            def prefill(params, tokens, positions, lengths, table,
+                        temps, seeds, steps, pools):
+                args = dict(params)
+                args.update(data=tokens, positions=positions,
+                            lengths=lengths, block_table=table)
+                for i in range(L):
+                    args[f"layer{i}_kpool"] = pools[2 * i]
+                    args[f"layer{i}_vpool"] = pools[2 * i + 1]
+                outs, _ = gfn(args, {}, gkey, False)
+                logits = outs[0]          # (1, Tp, V)
+                last = logits[jnp.arange(logits.shape[0]),
+                              lengths - 1]
+                toks = self._sample(last, temps, seeds, steps)
+                return toks, tuple(outs[1:])
+
+            i32 = np.dtype(np.int32)
+            specs = (self._spec_of(self._params),
+                     jax.ShapeDtypeStruct((1, tp), i32),
+                     jax.ShapeDtypeStruct((1, tp), i32),
+                     jax.ShapeDtypeStruct((1,), i32),
+                     jax.ShapeDtypeStruct((1, mb), i32),
+                     jax.ShapeDtypeStruct((1,), np.dtype(np.float32)),
+                     jax.ShapeDtypeStruct((1,), i32),
+                     jax.ShapeDtypeStruct((1,), i32),
+                     self._spec_of(self._pools))
+            with profiler.scope(f"serving.compile.prefill.t{tp}",
+                                "serving", args={"tokens": tp}):
+                jitted = jax.jit(
+                    prefill,
+                    donate_argnums=(8,) if self._donate else ())
+                exe = jitted.lower(*specs).compile()
+            self._exe_cache[key] = exe
+            self.compiles[key] = self.compiles.get(key, 0) + 1
+            return exe
+
+    # ------------------------------------------------------------------
+    # scheduler
+    # ------------------------------------------------------------------
+    def _loop(self):
+        try:
+            while True:
+                with self._cond:
+                    while self._alive and not self._pending \
+                            and not self._active:
+                        self._cond.wait(timeout=0.5)
+                    if not self._alive:
+                        return
+                self._admit()
+                if self._active:
+                    self._decode_step()
+                elif self._pending:
+                    # head-of-line request can't be admitted and no
+                    # stream is decoding (transient: submit racing the
+                    # loop) — don't busy-spin on the allocator
+                    with self._cond:
+                        self._cond.wait(timeout=0.05)
+                profiler.set_gauge("serving.active_streams",
+                                   len(self._active))
+        except BaseException as exc:
+            self._shut_door()  # before poisoning: submit() must not
+            self._fail_outstanding(EngineClosedError(  # re-queue work
+                f"DecodeEngine serving loop died: {exc!r}"))
+            raise
+        finally:
+            # door first, drain second: a submit that won the race and
+            # appended to _pending is caught by this drain; one that
+            # lost sees _accepting False and raises EngineClosedError
+            self._shut_door()
+            self._fail_outstanding(
+                EngineClosedError("DecodeEngine closed"))
+
+    def _shut_door(self):
+        with self._cond:
+            self._accepting = False
+            self._alive = False
+            self._cond.notify_all()
+
+    def _admit(self):
+        """Join pending requests: admission is keyed to free cache
+        blocks — the prompt's pages plus one block of decode headroom,
+        capped at the stream's LIFETIME page need (a request whose
+        prefill already holds every page it will ever touch needs no
+        headroom, and one sized exactly to the pool must still be
+        admittable)."""
+        while True:
+            with self._lock:
+                if not self._pending \
+                        or len(self._active) >= self._max_streams:
+                    return
+                s = self._pending[0]
+                seq = s.prefill_seq()
+                need = self._blocks_for(max(len(seq), 1),
+                                        self._kv_block)
+                lifetime = self._blocks_for(
+                    len(s.prompt) + s.max_new, self._kv_block)
+                if self._alloc.free_blocks < min(need + 1, lifetime):
+                    return  # not enough cache: hold the FIFO line
+                self._pending.pop(0)
+                self._admitting = s  # visible to _fail_outstanding
+            # On failure _admitting must STAY set until the loop's
+            # poison handler runs — clearing it first would strand the
+            # caller's future between pop and activation.
+            pages = self._alloc.alloc(need, owner=s.sid)
+            s.blocks = pages  # attach now: a dying prefill must not leak
+            self._prefill(s, seq, pages)
+            self._admitting = None
+
+    def _prefill(self, s: _Stream, seq: np.ndarray, pages: List[int]):
+        from .io import stage_array
+
+        n = len(seq)
+        tp = self._bucket(self._prefill_buckets, n, "prompt length")
+        mb = tp // self._kv_block
+        exe = self._prefill_exe(tp)
+        tokens = np.zeros((1, tp), np.int32)
+        tokens[0, :n] = seq
+        positions = np.arange(tp, dtype=np.int32)[None]
+        lengths = np.asarray([n], np.int32)
+        table = np.zeros((1, mb), np.int32)
+        table[0, :len(pages)] = pages
+        temps = np.asarray([s.temp], np.float32)
+        seeds = np.asarray([s.seed], np.int32)
+        steps = np.asarray([n - 1], np.int32)  # sampling position
+        dev = self._device
+        with profiler.scope(f"serving.prefill.t{tp}", "serving",
+                            args={"tokens": n, "bucket": tp,
+                                  "resume": s.resume}):
+            toks, self._pools = exe(
+                self._params, stage_array(tokens, dev),
+                stage_array(positions, dev), stage_array(lengths, dev),
+                stage_array(table, dev), stage_array(temps, dev),
+                stage_array(seeds, dev), stage_array(steps, dev),
+                self._pools)
+            first = int(np.asarray(toks)[0])
+        s.blocks = pages
+        s.length = n
+        s.t_admit = time.perf_counter()
+        if s.resume:
+            s.resume = False  # next_token survives preemption
+        else:
+            s.next_token = first
+            s.generated.append(first)
+            ttft = (s.t_admit - s.t_submit) * 1e3
+            self._metrics.observe("ttft_ms", ttft)
+            profiler.observe("serving.ttft_ms", ttft)
+            self._count("tokens")
+        self._count("prefills")
+        self._count("prefill_tokens", n)
+        if s.done():  # max_new == 1 or instant eos
+            self._retire(s)
+        else:
+            with self._lock:
+                self._active.append(s)
+
+    def _ensure_capacity(self, s: _Stream) -> bool:
+        """Grow ``s`` by one token's page if needed; preempt the
+        youngest other stream when the pool is exhausted.  False when
+        ``s`` itself could not be kept resident."""
+        if self._blocks_for(s.length + 1, self._kv_block) \
+                <= len(s.blocks):
+            return True
+        while True:
+            pages = self._alloc.alloc(1, owner=s.sid)
+            if pages is not None:
+                s.blocks.extend(pages)
+                return True
+            # a victim must be able to COME BACK: its resume
+            # re-prefill (prompt + progress = its cached tokens) has
+            # to fit the prefill ladder
+            victims = [v for v in self._active if v is not s
+                       and v.length <= self._prefill_buckets[-1]]
+            if not victims:
+                with self._lock:
+                    self._active.remove(s)
+                self._alloc.free(s.blocks)
+                s.blocks = []
+                if s.future.set_running_or_notify_cancel():
+                    s.future.set_exception(MXNetError(
+                        f"KV cache exhausted: stream {s.sid} needs a "
+                        f"page and no preemptable stream remains "
+                        f"(pool: {self._alloc.capacity} blocks, "
+                        f"largest resumable prefill: "
+                        f"{self._prefill_buckets[-1]} tokens); size "
+                        f"cache_blocks / the prefill ladder for the "
+                        f"workload"))
+                return False
+            victim = max(victims, key=lambda v: v.t_admit)
+            self._preempt(victim)
+
+    def _preempt(self, victim: _Stream):
+        """Recompute-style preemption: drop the victim's pages, requeue
+        it (front of the line) for re-prefill of prompt + progress."""
+        self._alloc.free(victim.blocks)
+        victim.blocks = []
+        victim.length = 0
+        victim.resume = True
+        with self._lock:
+            self._active.remove(victim)
+            self._pending.insert(0, victim)
+        self._count("preempted")
+
+    def _retire(self, s: _Stream):
+        if s.blocks:
+            self._alloc.free(s.blocks)
+            s.blocks = []
+        if s.future.set_running_or_notify_cancel():
+            s.future.set_result(np.asarray(s.generated, np.int32))
+        self._count("generations")
+
+    def _decode_step(self):
+        from .io import stage_array
+
+        t0 = time.perf_counter()
+        for s in list(self._active):
+            if s in self._active:
+                self._ensure_capacity(s)
+        with self._lock:
+            streams = list(self._active)
+        if not streams:
+            return
+        n = len(streams)
+        bb = self._bucket(self._decode_buckets, n, "active streams")
+        mb = self._bucket(self._cache_buckets,
+                          max(len(s.blocks) for s in streams),
+                          "cache blocks")
+        exe = self._decode_exe(bb, mb)
+        tokens = np.zeros((bb, 1), np.int32)
+        positions = np.zeros((bb, 1), np.int32)
+        lengths = np.zeros((bb,), np.int32)
+        table = np.zeros((bb, mb), np.int32)
+        temps = np.zeros((bb,), np.float32)
+        seeds = np.zeros((bb,), np.int32)
+        steps = np.zeros((bb,), np.int32)
+        for i, s in enumerate(streams):
+            tokens[i, 0] = s.next_token
+            positions[i, 0] = s.length
+            lengths[i] = s.length + 1
+            table[i, :len(s.blocks)] = s.blocks
+            temps[i] = s.temp
+            seeds[i] = s.seed
+            steps[i] = s.length  # the position being sampled FROM
+        dev = self._device
+        with profiler.scope(f"serving.decode_step.b{bb}x{mb}",
+                            "serving",
+                            args={"active": n, "batch": bb,
+                                  "blocks": mb}):
+            toks, self._pools = exe(
+                self._params, stage_array(tokens, dev),
+                stage_array(positions, dev), stage_array(lengths, dev),
+                stage_array(table, dev), stage_array(temps, dev),
+                stage_array(seeds, dev), stage_array(steps, dev),
+                self._pools)
+            toks = np.asarray(toks)
+        step_ms = (time.perf_counter() - t0) * 1e3
+        self._count("steps")
+        self._count("tokens", n)
+        self._metrics.observe("step_ms", step_ms)
+        profiler.observe("serving.decode_step_ms", step_ms)
+        retired = []
+        for i, s in enumerate(streams):
+            tok = int(toks[i])
+            s.generated.append(tok)
+            s.length += 1
+            s.next_token = tok
+            self._metrics.observe("time_per_token_ms", step_ms)
+            profiler.observe("serving.time_per_token_ms", step_ms)
+            if s.done():
+                retired.append(s)
+        if retired:
+            with self._lock:
+                for s in retired:
+                    self._active.remove(s)
+            for s in retired:
+                self._retire(s)

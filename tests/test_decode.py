@@ -1,0 +1,618 @@
+"""Autoregressive serving tests: KV-cache decode correctness (the
+bit-identity contract), paged block tables under fragmentation, the
+Pallas gather kernel vs the lax fallback, and the continuous-batching
+DecodeEngine (join/retire, preemption, admission, close-drain).
+
+Fast variants run in tier-1; the long decode loops and wide
+multi-stream sweeps are marked ``slow``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models, profiler
+from mxnet_tpu.executor import build_graph_fn
+from mxnet_tpu.kv_cache import (BlockAllocator, blocks_for_tokens,
+                                bucket_ladder)
+from mxnet_tpu.models.transformer import (transformer_lm_decode,
+                                          transformer_lm_prefill)
+
+V, KVB, L, H, DM, MAXLEN = 61, 4, 2, 2, 32, 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    """Tiny trained-shape transformer: params + a greedy full-forward
+    reference that goes through the TRAINING symbol (SoftmaxOutput
+    head), so decode is checked against the genuine serving target."""
+    import jax
+    import jax.numpy as jnp
+
+    sym = models.transformer_lm(V, MAXLEN, num_layers=L, num_heads=H,
+                                d_model=DM, block_size=KVB)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, MAXLEN))],
+             label_shapes=[("softmax_label", (2, MAXLEN))],
+             for_training=False)
+    mod.init_params(mx.initializer.Xavier(factor_type="in",
+                                          magnitude=2.0))
+    arg, aux = mod.get_params()
+    params = {**arg, **aux}
+
+    ps = transformer_lm_prefill(V, num_layers=L, num_heads=H,
+                                d_model=DM, kv_block=KVB, paged=False)
+    gfn = build_graph_fn(ps)
+    base = {n: jnp.asarray(params[n].asnumpy())
+            for n in ps.list_arguments() if n in params}
+    key = jax.random.PRNGKey(0)
+
+    def full_logits(seq):
+        """Full-sequence causal forward at the natural length."""
+        T = len(seq)
+        a = dict(base)
+        a.update(data=jnp.asarray(np.asarray(seq, np.int32)[None]),
+                 positions=jnp.asarray(
+                     np.arange(T, dtype=np.int32)[None]),
+                 lengths=jnp.asarray(np.asarray([T], np.int32)))
+        outs, _ = gfn(a, {}, key, False)
+        return np.asarray(outs[0][0])  # (T, V)
+
+    def naive_generate(prompt, n):
+        seq = list(np.asarray(prompt))
+        out = []
+        for _ in range(n):
+            out.append(int(np.argmax(full_logits(seq)[-1])))
+            seq.append(out[-1])
+        return np.asarray(out, np.int32)
+
+    return params, full_logits, naive_generate
+
+
+def _engine(params, **kw):
+    args = dict(vocab_size=V, num_layers=L, num_heads=H, d_model=DM,
+                max_len=MAXLEN, kv_block=KVB, max_streams=4,
+                decode_buckets=[1, 2, 4], temperature=0.0)
+    args.update(kw)
+    return mx.DecodeEngine(params, **args)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity of prefill + incremental decode vs the full forward
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_decode_logits_bitwise_contiguous(lm):
+    """Op-level contract: prefill + N contiguous decode steps produce
+    logits BIT-IDENTICAL to the full-sequence causal forward row, at
+    every step, across a cache-length bucket boundary (the cache here
+    is padded to C > T like a bucketed executable would)."""
+    import jax
+    import jax.numpy as jnp
+
+    params, full_logits, _ = lm
+    ds = transformer_lm_decode(V, num_layers=L, num_heads=H,
+                               d_model=DM, kv_block=KVB, paged=False)
+    gfn = build_graph_fn(ds)
+    base = {n: jnp.asarray(params[n].asnumpy())
+            for n in ds.list_arguments() if n in params}
+    key = jax.random.PRNGKey(0)
+
+    rng = np.random.RandomState(0)
+    seq = rng.randint(1, V, size=18).astype(np.int32)
+    p0 = 5
+    full = full_logits(seq)
+
+    # prefill via the prefill symbol (contiguous: caches come back as
+    # (B, T, H, D)); re-home them into a C=24-slot cache (crosses the
+    # 8->16->24 block boundaries as decode proceeds)
+    ps = transformer_lm_prefill(V, num_layers=L, num_heads=H,
+                                d_model=DM, kv_block=KVB, paged=False)
+    pgfn = build_graph_fn(ps)
+    a = dict(base)
+    a.update(data=jnp.asarray(seq[None, :p0]),
+             positions=jnp.asarray(np.arange(p0, dtype=np.int32)[None]),
+             lengths=jnp.asarray(np.asarray([p0], np.int32)))
+    pouts, _ = pgfn(a, {}, key, False)
+    np.testing.assert_array_equal(np.asarray(pouts[0][0]), full[:p0])
+
+    C = 24
+    caches = []
+    for kv in pouts[1:]:
+        c = np.zeros((1, C, H, DM // H), np.float32)
+        c[:, :p0] = np.asarray(kv)
+        caches.append(jnp.asarray(c))
+    for t in range(p0, len(seq)):
+        a = dict(base)
+        a.update(data=jnp.asarray(seq[None, t:t + 1]),
+                 positions=jnp.asarray(
+                     np.asarray([[t]], np.int32)),
+                 lengths=jnp.asarray(np.asarray([t + 1], np.int32)))
+        for i in range(L):
+            a[f"layer{i}_kcache"] = caches[2 * i]
+            a[f"layer{i}_vcache"] = caches[2 * i + 1]
+        outs, _ = gfn(a, {}, key, False)
+        np.testing.assert_array_equal(
+            np.asarray(outs[0][0, 0]), full[t],
+            err_msg=f"decode step t={t} not bit-identical")
+        caches = [jnp.asarray(x) for x in outs[1:]]
+
+
+def test_paged_decode_bitwise_under_fragmentation(lm):
+    """The paged path with a DELIBERATELY fragmented block table
+    (pages interleaved/allocated out of order, stale data in freed
+    pages) is bit-identical to the full forward."""
+    import jax
+    import jax.numpy as jnp
+
+    params, full_logits, _ = lm
+    ds = transformer_lm_decode(V, num_layers=L, num_heads=H,
+                               d_model=DM, kv_block=KVB, paged=True)
+    ps = transformer_lm_prefill(V, num_layers=L, num_heads=H,
+                                d_model=DM, kv_block=KVB, paged=True)
+    dfn, pfn = build_graph_fn(ds), build_graph_fn(ps)
+    base = {n: jnp.asarray(params[n].asnumpy())
+            for n in ds.list_arguments() if n in params}
+    key = jax.random.PRNGKey(0)
+
+    rng = np.random.RandomState(1)
+    seq = rng.randint(1, V, size=15).astype(np.int32)
+    p0 = 6
+    full = full_logits(seq)
+
+    P = 12
+    # stale garbage in the pool: a previous tenant's values must not
+    # leak through the masks (finite garbage — K/V are activations)
+    pools = [jnp.asarray(rng.randn(P, KVB, H, DM // H)
+                         .astype(np.float32)) for _ in range(2 * L)]
+    # fragmented page order from interleaved alloc/free
+    table = np.zeros((1, 4), np.int32)
+    table[0] = [7, 2, 11, 5]
+    a = dict(base)
+    a.update(data=jnp.asarray(seq[None, :p0]),
+             positions=jnp.asarray(np.arange(8, dtype=np.int32)[None]),
+             lengths=jnp.asarray(np.asarray([p0], np.int32)),
+             block_table=jnp.asarray(table[:, :2]))
+    a["data"] = jnp.asarray(
+        np.pad(seq[:p0], (0, 2))[None])  # prompt padded to bucket 8
+    for i in range(L):
+        a[f"layer{i}_kpool"] = pools[2 * i]
+        a[f"layer{i}_vpool"] = pools[2 * i + 1]
+    pouts, _ = pfn(a, {}, key, False)
+    np.testing.assert_array_equal(np.asarray(pouts[0][0, :p0]),
+                                  full[:p0])
+    pools = [jnp.asarray(x) for x in pouts[1:]]
+    for t in range(p0, len(seq)):
+        a = dict(base)
+        a.update(data=jnp.asarray(seq[None, t:t + 1]),
+                 positions=jnp.asarray(np.asarray([[t]], np.int32)),
+                 lengths=jnp.asarray(np.asarray([t + 1], np.int32)),
+                 block_table=jnp.asarray(table))
+        for i in range(L):
+            a[f"layer{i}_kpool"] = pools[2 * i]
+            a[f"layer{i}_vpool"] = pools[2 * i + 1]
+        outs, _ = dfn(a, {}, key, False)
+        np.testing.assert_array_equal(
+            np.asarray(outs[0][0, 0]), full[t],
+            err_msg=f"paged decode t={t} not bit-identical")
+        pools = [jnp.asarray(x) for x in outs[1:]]
+
+
+def test_paged_pallas_kernel_matches_lax(monkeypatch):
+    """The gather-by-block-table Pallas kernel (interpret mode on CPU
+    — the same kernel code path as TPU) matches the lax gather
+    fallback at dtype tolerance."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import pallas_kernels as pk
+    from mxnet_tpu.ops.attention import decode_attention
+
+    rng = np.random.RandomState(3)
+    B, nH, D, P, MB = 3, 2, 8, 10, 3
+    q = rng.randn(B, 1, nH, D).astype(np.float32)
+    kp = rng.randn(P, KVB, nH, D).astype(np.float32)
+    vp = rng.randn(P, KVB, nH, D).astype(np.float32)
+    table = np.array([[5, 2, 9], [1, 7, 3], [0, 0, 0]], np.int32)
+    lengths = np.array([9, 5, 0], np.int32)
+
+    monkeypatch.setenv("MXNET_PALLAS", "1")
+    assert pk.enabled()
+    out = np.asarray(pk.paged_attention_decode(
+        jnp.asarray(q[:, 0]), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(table), jnp.asarray(lengths)))
+    kg = kp[table].reshape(B, MB * KVB, nH, D)
+    vg = vp[table].reshape(B, MB * KVB, nH, D)
+    ref = np.asarray(decode_attention(
+        jnp.asarray(q), jnp.asarray(kg), jnp.asarray(vg),
+        jnp.asarray(lengths), KVB))[:, 0]
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+    # a fully-masked (inactive) stream produces zeros, not NaN
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out[2], np.zeros_like(out[2]))
+
+
+def test_paged_op_pallas_vs_lax_path(monkeypatch, lm):
+    """QKVPagedAttentionDecode end to end: the kernel path equals the
+    lax path at tolerance on identical pools/tables."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import invoke
+
+    rng = np.random.RandomState(4)
+    B, nH, D, P = 2, 2, 8, 8
+    qkv = rng.randn(B, 1, 3 * nH * D).astype(np.float32)
+    kp = rng.randn(P, KVB, nH, D).astype(np.float32)
+    vp = rng.randn(P, KVB, nH, D).astype(np.float32)
+    table = np.array([[3, 6], [1, 4]], np.int32)
+    lengths = np.array([6, 3], np.int32)
+    ins = [jnp.asarray(x) for x in (qkv, kp, vp, table, lengths)]
+
+    monkeypatch.setenv("MXNET_PALLAS", "0")
+    (o_lax, k_lax, v_lax), _ = invoke("QKVPagedAttentionDecode", ins,
+                                      {"num_heads": nH})
+    monkeypatch.setenv("MXNET_PALLAS", "1")
+    (o_pal, k_pal, v_pal), _ = invoke("QKVPagedAttentionDecode", ins,
+                                      {"num_heads": nH})
+    np.testing.assert_allclose(np.asarray(o_pal), np.asarray(o_lax),
+                               rtol=1e-6, atol=1e-6)
+    # the cache write is the same scatter on both paths
+    np.testing.assert_array_equal(np.asarray(k_pal), np.asarray(k_lax))
+    np.testing.assert_array_equal(np.asarray(v_pal), np.asarray(v_lax))
+
+
+# ---------------------------------------------------------------------------
+# block allocator
+# ---------------------------------------------------------------------------
+
+
+def test_block_allocator_alloc_free_fragmentation():
+    a = BlockAllocator(9, 4)  # 1 scratch + 8 usable
+    assert a.capacity == 8 and a.free_blocks == 8
+    x = a.alloc(3, owner="x")
+    y = a.alloc(2, owner="y")
+    assert len(set(x) | set(y)) == 5 and 0 not in x + y
+    assert a.used_blocks == 5
+    a.free(x)  # interleaved free fragments the id space
+    with pytest.raises(mx.MXNetError, match="double free|foreign"):
+        a.free([x[0]])
+    z = a.alloc(4, owner="z")
+    assert z is not None and 0 not in z
+    assert set(z).isdisjoint(y)
+    # all-or-nothing: 3 left, asking 4 takes nothing
+    assert a.alloc(4) is None
+    assert a.free_blocks == 2
+    assert a.alloc(2) is not None
+    assert a.utilization() == 1.0
+    with pytest.raises(mx.MXNetError, match="scratch"):
+        a.free([0])
+    with pytest.raises(mx.MXNetError, match=">= 2"):
+        BlockAllocator(1, 4)
+
+
+def test_blocks_for_tokens_and_ladder():
+    assert blocks_for_tokens(1, 4) == 1
+    assert blocks_for_tokens(4, 4) == 1
+    assert blocks_for_tokens(5, 4) == 2
+    assert bucket_ladder(8) == [1, 2, 4, 8]
+    assert bucket_ladder(6) == [1, 2, 4, 6]
+    assert bucket_ladder(1) == [1]
+
+
+# ---------------------------------------------------------------------------
+# DecodeEngine: the tier-1 smoke (4-token decode on the tiny model)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_smoke_greedy_decode(lm):
+    """4-token greedy decode on a tiny model equals the full-forward
+    argmax chain — the tier-1-visible variant of the slow loops."""
+    params, _, naive_generate = lm
+    prompt = np.array([3, 17, 42, 5, 9], np.int32)
+    with _engine(params) as eng:
+        got = eng.generate(prompt, 4)
+        st = eng.stats()
+    np.testing.assert_array_equal(got, naive_generate(prompt, 4))
+    assert st["generations"] == 1 and st["tokens"] == 4
+    assert st["prefill_tokens"] == 5
+
+
+def test_engine_admission_and_cache_accounting(lm):
+    params, _, _ = lm
+    with _engine(params, cache_blocks=33) as eng:
+        f = eng.submit(np.arange(1, 6, dtype=np.int32), 3)
+        f.result(timeout=120)
+        st = eng.stats()
+        # everything retired: all pages back in the pool
+        assert st["cache_util"] == 0.0
+        assert st["cache_blocks_free"] == 32
+        assert st["preempted"] == 0
+
+
+def test_engine_submit_validation(lm):
+    params, _, _ = lm
+    with _engine(params) as eng:
+        with pytest.raises(mx.MXNetError, match="non-empty 1-D"):
+            eng.submit(np.zeros((2, 3), np.int32), 4)
+        with pytest.raises(mx.MXNetError, match="max_len"):
+            eng.submit(np.arange(30, dtype=np.int32), 10)
+        with pytest.raises(mx.MXNetError, match="max_new_tokens"):
+            eng.submit(np.arange(3, dtype=np.int32), 0)
+    with pytest.raises(mx.EngineClosedError):
+        eng.submit(np.arange(3, dtype=np.int32), 2)
+
+
+def test_engine_eos_stops_early(lm):
+    """Greedy chains revisit tokens; use the first generated token as
+    eos so generation must stop right after producing it again."""
+    params, _, naive_generate = lm
+    prompt = np.array([3, 17, 42, 5, 9], np.int32)
+    ref = naive_generate(prompt, 6)
+    eos = int(ref[2])
+    with _engine(params) as eng:
+        got = eng.generate(prompt, 6, eos_id=eos)
+    stop = int(np.argmax(ref == eos)) + 1
+    np.testing.assert_array_equal(got, ref[:stop])
+    assert got[-1] == eos
+
+
+def test_engine_close_fails_inflight_with_named_error(lm):
+    """The drain test: close() during an in-flight decode fails the
+    outstanding futures with EngineClosedError at wait — never a
+    hang."""
+    params, _, _ = lm
+    eng = _engine(params)
+    futs = [eng.submit(np.arange(1, 5, dtype=np.int32), 25)
+            for _ in range(3)]
+    time.sleep(0.05)  # let the scheduler pick them up
+    t0 = time.perf_counter()
+    eng.close(timeout=60)
+    assert time.perf_counter() - t0 < 60
+    for f in futs:
+        with pytest.raises(mx.EngineClosedError, match="closed"):
+            f.result(timeout=10)
+
+
+def test_inference_engine_batch_loop_death_poisons_futures():
+    """InferenceEngine: a dying batch loop fails queued futures with
+    the named error instead of stranding them (failure poisoning
+    raises at wait instead of hanging)."""
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    mod = mx.mod.Module(net, context=mx.cpu(), label_names=[])
+    mod.bind(data_shapes=[("data", (2, 6))], for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    arg, aux = mod.get_params()
+    pred = mx.Predictor(net, {**arg, **aux}, {"data": (1, 6)})
+    eng = mx.InferenceEngine(pred, buckets=(4,), batch_timeout_ms=1.0)
+    try:
+        # sabotage the coalescing loop itself (outside _dispatch's
+        # per-batch try/except): `t_first + None` raises TypeError
+        eng._timeout_s = eng._idle_timeout_s = None
+        fut = eng.submit(np.zeros((1, 6), np.float32))
+        with pytest.raises(mx.EngineClosedError, match="died"):
+            fut.result(timeout=30)
+    finally:
+        eng._queue.put(None)  # loop is dead; unblock close's join
+        eng.close(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# env-var validation (MXNET_CKPT_* convention: garbage raises loudly)
+# ---------------------------------------------------------------------------
+
+
+def test_env_validation_garbage_raises(monkeypatch, lm):
+    params, _, _ = lm
+    monkeypatch.setenv("MXNET_SERVING_KV_BLOCK", "banana")
+    with pytest.raises(mx.MXNetError, match="MXNET_SERVING_KV_BLOCK"):
+        mx.DecodeEngine(params, vocab_size=V, num_layers=L,
+                        num_heads=H, d_model=DM, max_len=MAXLEN)
+    monkeypatch.setenv("MXNET_SERVING_KV_BLOCK", "-4")
+    with pytest.raises(mx.MXNetError, match="MXNET_SERVING_KV_BLOCK"):
+        mx.DecodeEngine(params, vocab_size=V, num_layers=L,
+                        num_heads=H, d_model=DM, max_len=MAXLEN)
+    monkeypatch.delenv("MXNET_SERVING_KV_BLOCK")
+    monkeypatch.setenv("MXNET_SERVING_MAX_STREAMS", "0")
+    with pytest.raises(mx.MXNetError,
+                       match="MXNET_SERVING_MAX_STREAMS"):
+        mx.DecodeEngine(params, vocab_size=V, num_layers=L,
+                        num_heads=H, d_model=DM, max_len=MAXLEN)
+    monkeypatch.delenv("MXNET_SERVING_MAX_STREAMS")
+    monkeypatch.setenv("MXNET_SERVING_DECODE_BUCKETS", "4,2,1")
+    with pytest.raises(mx.MXNetError, match="increasing"):
+        mx.DecodeEngine(params, vocab_size=V, num_layers=L,
+                        num_heads=H, d_model=DM, max_len=MAXLEN)
+    monkeypatch.setenv("MXNET_SERVING_DECODE_BUCKETS", "1,zebra")
+    with pytest.raises(mx.MXNetError, match="comma-separated"):
+        mx.DecodeEngine(params, vocab_size=V, num_layers=L,
+                        num_heads=H, d_model=DM, max_len=MAXLEN)
+    monkeypatch.delenv("MXNET_SERVING_DECODE_BUCKETS")
+    monkeypatch.setenv("MXNET_SERVING_PREFILL_BUCKETS", "3,7")
+    with pytest.raises(mx.MXNetError, match="multiple of"):
+        mx.DecodeEngine(params, vocab_size=V, num_layers=L,
+                        num_heads=H, d_model=DM, max_len=MAXLEN)
+    # registered in the config catalog
+    for name in ("MXNET_SERVING_KV_BLOCK", "MXNET_SERVING_MAX_STREAMS",
+                 "MXNET_SERVING_DECODE_BUCKETS",
+                 "MXNET_SERVING_CACHE_BUCKETS",
+                 "MXNET_SERVING_PREFILL_BUCKETS"):
+        assert mx.config.describe(name).name == name
+
+
+def test_ladder_coverage_validated_at_construction(lm):
+    """A ladder that doesn't cover the configured maxima would kill the
+    serving loop mid-flight (a _bucket miss poisons every outstanding
+    future) — it must raise at construction instead.  Explicit
+    prefill_buckets get the same strictly-increasing check as the
+    other ladders."""
+    params, _, _ = lm
+    with pytest.raises(mx.MXNetError, match="does not cover"):
+        _engine(params, max_streams=8, decode_buckets=[1, 2, 4])
+    with pytest.raises(mx.MXNetError, match="does not cover"):
+        _engine(params, cache_buckets=[1, 2])  # MAXLEN/KVB = 8 pages
+    with pytest.raises(mx.MXNetError, match="bad prefill_buckets"):
+        _engine(params, prefill_buckets=[16, 8])
+
+
+def test_reset_stats_isolates_measurement_points(lm):
+    """bench_serving sweeps one engine across load points; reset_stats
+    must zero counters AND histogram reservoirs so a point's
+    percentiles don't blend earlier points' samples."""
+    params, _, _ = lm
+    with _engine(params) as eng:
+        eng.generate(np.arange(1, 5, dtype=np.int32), 4)
+        st = eng.stats()
+        assert st["tokens"] >= 4 and st["p50_ms"] is not None
+        eng.reset_stats()
+        st = eng.stats()
+        assert st["tokens"] == 0 and st["p50_ms"] is None
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: join/retire and preemption (slow variants)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_multi_stream_join_retire_outputs_unchanged(lm):
+    """Streams joining and retiring mid-loop (staggered submits,
+    different lengths) leave every stream's output identical to its
+    single-stream generation."""
+    params, _, naive_generate = lm
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, V, size=n).astype(np.int32)
+               for n in (5, 3, 7, 1, 6, 4)]
+    lens = [12, 5, 9, 17, 2, 8]
+    with _engine(params, max_streams=4) as eng:
+        futs = []
+        for i, (p, n) in enumerate(zip(prompts, lens)):
+            futs.append(eng.submit(p, n))
+            if i == 2:
+                time.sleep(0.1)  # stagger: join mid-loop
+        outs = [f.result(timeout=300) for f in futs]
+        st = eng.stats()
+    for p, n, o in zip(prompts, lens, outs):
+        np.testing.assert_array_equal(o, naive_generate(p, n))
+    assert st["generations"] == len(prompts)
+    # continuous batching actually batched: fewer steps than tokens
+    assert st["steps"] < st["tokens"]
+
+
+@pytest.mark.slow
+def test_preemption_recompute_outputs_unchanged(lm):
+    """A pool too small for all streams forces preemption; preempted
+    streams re-prefill their progress and still produce exactly their
+    single-stream outputs."""
+    params, _, naive_generate = lm
+    prompts = [np.arange(1, 6, dtype=np.int32),
+               np.arange(7, 12, dtype=np.int32),
+               np.arange(13, 18, dtype=np.int32)]
+    with _engine(params, max_streams=3, cache_blocks=10) as eng:
+        futs = [eng.submit(p, 14) for p in prompts]
+        outs = [f.result(timeout=300) for f in futs]
+        st = eng.stats()
+    assert st["preempted"] > 0
+    for p, o in zip(prompts, outs):
+        np.testing.assert_array_equal(o, naive_generate(p, 14))
+
+
+@pytest.mark.slow
+def test_temperature_sampling_reproducible_across_batching(lm):
+    """Per-stream PRNG keys are (engine seed, stream id, position):
+    the same request sampled alone and sampled inside a busy batch
+    yields the same tokens."""
+    params, _, _ = lm
+    prompt = np.array([3, 17, 42], np.int32)
+    with _engine(params, seed=11) as eng:
+        alone = eng.generate(prompt, 8, temperature=0.8)
+    with _engine(params, seed=11) as eng:
+        futs = [eng.submit(prompt, 8, temperature=0.8),
+                eng.submit(np.array([9, 9], np.int32), 8,
+                           temperature=0.5)]
+        batched = futs[0].result(timeout=300)
+    np.testing.assert_array_equal(alone, batched)
+
+
+@pytest.mark.slow
+def test_long_decode_loop_across_cache_buckets(lm):
+    """A generation long enough to cross several cache-length buckets
+    (block-table growth mid-stream) stays bit-exact."""
+    params, _, naive_generate = lm
+    prompt = np.array([2, 4], np.int32)
+    n = 28  # 30 tokens total = 8 blocks: crosses 1->2->4->8 buckets
+    with _engine(params, cache_buckets=[1, 2, 4, 8]) as eng:
+        got = eng.generate(prompt, n)
+    np.testing.assert_array_equal(got, naive_generate(prompt, n))
+
+
+def test_capacity_edge_request_admits(lm):
+    """A request whose lifetime page need is EXACTLY the pool capacity
+    must still be served — admission's +1 decode headroom is capped at
+    the lifetime need (review finding: it used to hold the FIFO line
+    forever while the scheduler spun)."""
+    params, _, naive_generate = lm
+    # capacity 4 pages = 16 tokens; 15-token prompt + 1 token fills it
+    prompt = np.arange(1, 16, dtype=np.int32)
+    with _engine(params, cache_blocks=5, max_streams=1) as eng:
+        out = eng.submit(prompt, 1).result(timeout=120)
+    np.testing.assert_array_equal(out, naive_generate(prompt, 1))
+
+
+def test_prefill_failure_fails_the_admitted_future(lm):
+    """A stream popped from pending whose prefill dies must get the
+    poison error like everyone else, not hang (review finding: it was
+    invisible to _fail_outstanding between pop and activation)."""
+    params, _, _ = lm
+    eng = _engine(params)
+    try:
+        def boom(tp):
+            raise RuntimeError("injected prefill failure")
+
+        eng._prefill_exe = boom
+        fut = eng.submit(np.arange(1, 5, dtype=np.int32), 4)
+        with pytest.raises(mx.EngineClosedError, match="died"):
+            fut.result(timeout=60)
+        # the dead loop also shut the door: a later submit raises
+        # instead of queueing work nothing will ever process
+        with pytest.raises(mx.EngineClosedError):
+            eng.submit(np.arange(1, 5, dtype=np.int32), 4)
+    finally:
+        eng.close(timeout=10)
+
+
+def test_multi_token_decode_qkv_rejected():
+    """Both decode ops refuse a multi-token qkv instead of silently
+    attending only the first token."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.registry import invoke
+
+    rng = np.random.RandomState(9)
+    nH, D = 2, 8
+    qkv2 = jnp.asarray(rng.randn(1, 2, 3 * nH * D).astype(np.float32))
+    kp = jnp.zeros((4, KVB, nH, D))
+    table = jnp.zeros((1, 2), jnp.int32)
+    lengths = jnp.asarray([3], jnp.int32)
+    with pytest.raises(mx.MXNetError, match="ONE query position"):
+        invoke("QKVPagedAttentionDecode", [qkv2, kp, kp, table, lengths],
+               {"num_heads": nH})
+    ck = jnp.zeros((1, 8, nH, D))
+    with pytest.raises(mx.MXNetError, match="ONE query position"):
+        invoke("QKVSelfAttentionDecode", [qkv2, ck, ck, lengths],
+               {"num_heads": nH})
+
+
+def test_decode_telemetry_surfaces(lm):
+    profiler.reset_metrics()
+    params, _, _ = lm
+    with _engine(params) as eng:
+        eng.generate(np.arange(1, 5, dtype=np.int32), 4)
+    summ = profiler.metrics_summary()
+    assert summ["counters"]["serving.tokens"] >= 4
+    assert summ["counters"]["serving.prefills"] >= 1
+    assert "serving.time_per_token_ms" in summ["histograms"]
+    assert "serving.cache_util" in summ["gauges"]
+    assert "serving.active_streams" in summ["gauges"]
