@@ -45,6 +45,9 @@ from . import kvstore as kv
 from . import model
 from . import checkpoint
 from .checkpoint import CheckpointManager
+from . import elastic
+from .elastic import DeadRankError, Membership
+from . import chaos
 from . import module
 from . import module as mod
 from . import operator

@@ -1,0 +1,190 @@
+"""Chaos — reusable fault-injection harness for elastic-training drills.
+
+The checkpoint layer's ``MXNET_CKPT_CRASH`` proved the pattern: faults
+injected through declared env points, validated loudly at
+construction, compiled into cheap predicates on the hot path.  This
+module generalizes it to the failure modes the elasticity layer must
+survive (ISSUE 8; used by tools/chaos_drill.py and tests/test_dist.py):
+
+======================================  =================================
+env point                               effect
+======================================  =================================
+``MXNET_CHAOS_KILL_STEP=<n>``           SIGKILL this process at the start
+                                        of fit step ``n`` (0-based count
+                                        of steps run by THIS process) —
+                                        the rank-death drill.
+``MXNET_CHAOS_DEAD_RANK_STEP=<n>``      raise :class:`~mxnet_tpu.elastic.
+                                        DeadRankError` (dead ranks from
+                                        ``MXNET_CHAOS_DEAD_RANKS``, default
+                                        ``[1]``) at step ``n`` ONCE — the
+                                        single-process recovery smoke.
+``MXNET_CHAOS_HEARTBEAT_STALL=<s>``     the heartbeat writer goes silent
+                                        for ``s`` seconds after its first
+                                        beat (delayed-heartbeat fault).
+``MXNET_CHAOS_TORN_SOCKET=<n>``         the ``n``-th PS wire frame this
+                                        process sends is torn mid-frame
+                                        (half the bytes, then the socket
+                                        dies) — exercises the bounded
+                                        reconnect path.
+``MXNET_CHAOS_SLOW_RANK=<s>``           sleep ``s`` seconds at every fit
+                                        step (straggler fault).
+``MXNET_CHAOS_RANK=<r>``                faults apply only on rank ``r``
+                                        (default: every rank).
+======================================  =================================
+
+All values are validated at :class:`Chaos` construction — a typo'd
+spec raises instead of silently never firing.  NEVER set in production.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import time
+from typing import List, Optional
+
+from .base import MXNetError
+
+__all__ = ["Chaos", "get_chaos", "reset_chaos"]
+
+_VARS = ("MXNET_CHAOS_KILL_STEP", "MXNET_CHAOS_DEAD_RANK_STEP",
+         "MXNET_CHAOS_DEAD_RANKS", "MXNET_CHAOS_HEARTBEAT_STALL",
+         "MXNET_CHAOS_TORN_SOCKET", "MXNET_CHAOS_SLOW_RANK",
+         "MXNET_CHAOS_RANK")
+
+
+class Chaos:
+    """Compiled fault plan for ONE process (reads the env once).  All
+    values resolve through the config catalog via the shared validated
+    reader (``elastic._validated_env``) — one parser, loud errors."""
+
+    def __init__(self):
+        from .elastic import _validated_env
+
+        self.kill_step = _validated_env("MXNET_CHAOS_KILL_STEP",
+                                        minimum=0)
+        self.dead_rank_step = _validated_env("MXNET_CHAOS_DEAD_RANK_STEP",
+                                             minimum=0)
+        raw_ranks = _validated_env("MXNET_CHAOS_DEAD_RANKS")
+        try:
+            self.dead_ranks: List[int] = sorted(
+                int(t) for t in raw_ranks.split(",") if t.strip() != "")
+        except ValueError:
+            raise MXNetError(
+                f"invalid MXNET_CHAOS_DEAD_RANKS={raw_ranks!r}: expected a "
+                "CSV of ranks")
+        self.heartbeat_stall = _validated_env(
+            "MXNET_CHAOS_HEARTBEAT_STALL", minimum=0.0)
+        self.torn_socket = _validated_env("MXNET_CHAOS_TORN_SOCKET",
+                                          minimum=1)
+        self.slow_rank = _validated_env("MXNET_CHAOS_SLOW_RANK",
+                                        minimum=0.0)
+        self.rank_filter = _validated_env("MXNET_CHAOS_RANK", minimum=0)
+        self._dead_rank_fired = False
+        self._stall_fired = False
+        self._frames_sent = 0
+        self._log = logging.getLogger("mxnet_tpu.chaos")
+
+    @property
+    def armed(self) -> bool:
+        return any(v is not None for v in (
+            self.kill_step, self.dead_rank_step, self.heartbeat_stall,
+            self.torn_socket, self.slow_rank))
+
+    def _applies(self, rank: Optional[int]) -> bool:
+        return (self.rank_filter is None or rank is None
+                or int(rank) == self.rank_filter)
+
+    # -- fit-step faults ----------------------------------------------
+    def on_step(self, step: int, rank: Optional[int] = None) -> None:
+        """Called at the start of each fit step with this process's
+        0-based step count; may kill, stall, or raise a DeadRankError
+        verdict (the single-process smoke's injection point)."""
+        if not self._applies(rank):
+            return
+        if self.slow_rank:
+            time.sleep(self.slow_rank)
+        if self.kill_step is not None and step >= self.kill_step:
+            self._log.warning("[chaos] MXNET_CHAOS_KILL_STEP=%d firing: "
+                              "SIGKILL", self.kill_step)
+            # flush stdio so the drill can see everything up to the kill
+            import sys
+
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+        if (self.dead_rank_step is not None and not self._dead_rank_fired
+                and step >= self.dead_rank_step):
+            self._dead_rank_fired = True
+            from .elastic import DeadRankError
+
+            self._log.warning(
+                "[chaos] MXNET_CHAOS_DEAD_RANK_STEP=%d firing: injecting "
+                "DeadRankError(%s)", self.dead_rank_step, self.dead_ranks)
+            raise DeadRankError(self.dead_ranks,
+                                detail="chaos-injected dead-rank fault")
+
+    # -- heartbeat fault ----------------------------------------------
+    def heartbeat_stall_s(self, rank: Optional[int] = None) -> float:
+        """Seconds the heartbeat writer should stay silent after its
+        first beat (0 = healthy); consumed once."""
+        if (self.heartbeat_stall is None or self._stall_fired
+                or not self._applies(rank)):
+            return 0.0
+        self._stall_fired = True
+        return float(self.heartbeat_stall)
+
+    # -- wire fault ----------------------------------------------------
+    def torn_send(self, sock, payload: bytes,
+                  rank: Optional[int] = None) -> bool:
+        """If the torn-socket fault is armed for this frame: send HALF
+        the frame, then kill the socket (the server discards the torn
+        frame; the client's reconnect path must recover).  Returns True
+        when the fault fired (caller must treat the send as failed)."""
+        if self.torn_socket is None or not self._applies(rank):
+            return False
+        self._frames_sent += 1
+        if self._frames_sent != self.torn_socket:
+            return False
+        self._log.warning("[chaos] MXNET_CHAOS_TORN_SOCKET=%d firing: "
+                          "tearing frame mid-send", self.torn_socket)
+        try:
+            sock.sendall(payload[:max(1, len(payload) // 2)])
+        except OSError:
+            pass
+        try:
+            sock.shutdown(2)  # SHUT_RDWR — peer sees a torn frame
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+        return True
+
+
+_SINGLETON: Optional[Chaos] = None
+_SINGLETON_KEY = None
+
+
+def _env_key():
+    return tuple(os.environ.get(v) for v in _VARS)
+
+
+def get_chaos() -> Chaos:
+    """Process-wide chaos plan; rebuilt when the MXNET_CHAOS_* env
+    changes (tests monkeypatch between cases)."""
+    global _SINGLETON, _SINGLETON_KEY
+    key = _env_key()
+    if _SINGLETON is None or key != _SINGLETON_KEY:
+        _SINGLETON = Chaos()
+        _SINGLETON_KEY = key
+    return _SINGLETON
+
+
+def reset_chaos() -> None:
+    """Drop the cached plan (so one-shot faults re-arm)."""
+    global _SINGLETON, _SINGLETON_KEY
+    _SINGLETON = None
+    _SINGLETON_KEY = None

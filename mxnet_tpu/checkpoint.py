@@ -374,6 +374,8 @@ class CheckpointManager:
         self._step = 0          # update count; checkpoint id
         self._save_count = 0    # saves attempted (crash-injection index)
         self._in_step = False
+        self._in_rollback = False
+        self._exiting = False
         self._preempted = False
         self._signum = None
         self._prev_handler = None
@@ -418,6 +420,36 @@ class CheckpointManager:
 
     def step_begin(self) -> None:
         self._in_step = True
+
+    def step_abandoned(self) -> None:
+        """The step died mid-flight (e.g. a DeadRankError verdict):
+        clear the in-step latch WITHOUT advancing the counter, so a
+        deferred emergency save isn't parked forever behind a step_end
+        that will never come."""
+        self._in_step = False
+
+    def rollback(self):
+        """Context manager guarding an elastic rollback (fit's
+        re-mesh + restore).  A SIGTERM emergency save firing MID-
+        rollback would snapshot half-restored training state — and the
+        handler can interrupt the rollback's own save/restore file I/O
+        (the re-entrancy race).  Inside the guard the handler only
+        latches ``_preempted``; the deferred emergency save runs at
+        guard exit, a consistent boundary — the same discipline
+        ``step_begin``/``step_end`` applies to training steps."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def guard():
+            self._in_rollback = True
+            try:
+                yield
+            finally:
+                self._in_rollback = False
+                if self._preempted:
+                    self._emergency_exit()
+
+        return guard()
 
     def step_end(self, module, epoch: int, nbatch: int,
                  train_iter=None) -> None:
@@ -803,10 +835,16 @@ class CheckpointManager:
         self.logger.warning("[ckpt] signal %d: emergency checkpoint "
                             "requested", signum)
         self._preempted = True
-        if not self._in_step:
+        if not self._in_step and not self._in_rollback:
             self._emergency_exit()
 
     def _emergency_exit(self):
+        # re-entrancy guard: a second signal while the emergency save
+        # runs (its file I/O is interruptible) re-enters this handler —
+        # one save, one exit, no torn double-write
+        if self._exiting:
+            return
+        self._exiting = True
         signum = self._signum or signal.SIGTERM
         try:
             if self._module is not None and self._step > 0:

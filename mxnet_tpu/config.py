@@ -122,10 +122,90 @@ register_env(
 register_env(
     "MXNET_KVSTORE_HEARTBEAT_DIR", None, str,
     "Shared directory for worker heartbeat files (liveness /  "
-    "get_num_dead_node).  Set by tools/launch.py.")
+    "get_num_dead_node) and, in elastic mode, the membership ledger.  "
+    "Set by tools/launch.py.")
 register_env(
     "MXNET_KVSTORE_HEARTBEAT_INTERVAL", 1.0, float,
-    "Seconds between heartbeat file touches.")
+    "DEPRECATED alias of MXNET_HEARTBEAT_INTERVAL (still honored when "
+    "the new name is unset).")
+register_env(
+    "MXNET_HEARTBEAT_INTERVAL", 1.0, float,
+    "Seconds between heartbeat-file touches — the single liveness-"
+    "cadence knob read by the kvstore heartbeat writer and implied by "
+    "every staleness scan.  Must be well under "
+    "MXNET_DEAD_RANK_TIMEOUT.  Garbage or non-positive values raise at "
+    "kvstore construction.")
+register_env(
+    "MXNET_DEAD_RANK_TIMEOUT", 60.0, float,
+    "Heartbeat-staleness threshold in seconds: a worker whose "
+    "heartbeat file is older than this counts as DEAD — the default "
+    "timeout of kvstore.get_num_dead_node/dead_ranks, the elastic "
+    "barrier's verdict deadline, and the bound on parameter-server "
+    "sync-round waits in elastic mode.  Detection latency of the "
+    "2->1 re-mesh is bounded by this value.  Size it ABOVE the "
+    "worst-case scheduling stall of a healthy rank (an overloaded "
+    "host that can't run the heartbeat thread for this long gets "
+    "falsely convicted) and so that ~6x its value exceeds a "
+    "re-admitted rank's restore+compile warm-up (the survivors' "
+    "bounded retries cover that window).  Garbage or non-positive "
+    "values raise at kvstore construction.")
+register_env(
+    "MXNET_ELASTIC", 0, int,
+    "1: elastic fault-tolerant training.  dist kvstores run the "
+    "survivable control plane — file-based barriers with a "
+    "DeadRankError verdict instead of uninterruptible collectives, a "
+    "membership-epoch ledger in MXNET_KVSTORE_HEARTBEAT_DIR, gradient "
+    "traffic forced onto the reconnectable parameter-server transport, "
+    "and epoch-fenced wire frames.  Module.fit then survives rank "
+    "death: re-mesh to the survivors, roll back to the last committed "
+    "checkpoint, resume, and re-admit returning ranks at checkpoint "
+    "boundaries.  See README 'Elastic training'.  0 (default): the "
+    "fixed-membership paths.")
+register_env(
+    "MXNET_ELASTIC_JOIN", 0, int,
+    "1: this process is a RETURNING rank re-joining a live elastic run "
+    "(set by tools/chaos_drill.py / the elastic launcher on respawn, "
+    "never by hand): the kvstore skips jax.distributed and discovers "
+    "the run from the membership ledger, files a join request once "
+    "warm, and waits to be admitted at a checkpoint boundary.")
+register_env(
+    "MXNET_KVSTORE_RECONNECTS", 3, int,
+    "Bounded reconnect budget of a parameter-server client connection: "
+    "transient socket failures (ECONNRESET/EPIPE mid-frame) retry with "
+    "exponential backoff + jitter up to this many times before the "
+    "connection is declared dead (and the comm scheduler poisoned).  "
+    "0 disables reconnecting.  Counted in the ps.reconnects profiler "
+    "counter.")
+register_env(
+    "MXNET_CHAOS_KILL_STEP", None, int,
+    "CHAOS fault injection (tools/chaos_drill.py): SIGKILL this "
+    "process at the start of fit step N.  Honors MXNET_CHAOS_RANK.  "
+    "NEVER set in production.")
+register_env(
+    "MXNET_CHAOS_DEAD_RANK_STEP", None, int,
+    "CHAOS: raise DeadRankError (ranks from MXNET_CHAOS_DEAD_RANKS, "
+    "default '1') at fit step N, once — the single-process "
+    "rollback-resume smoke.  NEVER set in production.")
+register_env(
+    "MXNET_CHAOS_DEAD_RANKS", "1", str,
+    "CHAOS: CSV of ranks MXNET_CHAOS_DEAD_RANK_STEP pretends died.")
+register_env(
+    "MXNET_CHAOS_HEARTBEAT_STALL", None, float,
+    "CHAOS: the heartbeat writer goes silent for S seconds after its "
+    "first beat (delayed-heartbeat fault).  NEVER set in production.")
+register_env(
+    "MXNET_CHAOS_TORN_SOCKET", None, int,
+    "CHAOS: tear the N-th parameter-server wire frame mid-send (half "
+    "the bytes, then the socket dies) — exercises the bounded "
+    "reconnect.  NEVER set in production.")
+register_env(
+    "MXNET_CHAOS_SLOW_RANK", None, float,
+    "CHAOS: sleep S seconds at every fit step (straggler fault).  "
+    "NEVER set in production.")
+register_env(
+    "MXNET_CHAOS_RANK", None, int,
+    "CHAOS: apply the MXNET_CHAOS_* faults only on this rank "
+    "(default: every rank).")
 register_env(
     "MXNET_KVSTORE_BIGARRAY_BOUND", 1000 * 1000, int,
     "Element count above which a dist-kvstore array is split flat "

@@ -1,0 +1,416 @@
+"""Elastic fault-tolerant training — membership, epochs, verdicts.
+
+The fixed-worker-set assumption of the reference design (SURVEY
+§engine/kvstore) means one dead rank hangs every barrier and sync
+round forever.  This module is the control plane that removes it:
+
+* :class:`DeadRankError` — the actionable **failure verdict**.  The
+  straggler watchdog (PR 2) only *named* the late rank; in elastic mode
+  (``MXNET_ELASTIC=1``) a barrier timeout or transport failure whose
+  heartbeat scan confirms a stale peer raises this instead of hanging,
+  carrying *which* ranks died and at which membership epoch.
+* :class:`Membership` — a file-based ledger (in the launcher's shared
+  ``MXNET_KVSTORE_HEARTBEAT_DIR``) recording the membership **epoch**:
+  a monotonic counter plus the active rank set, the parameter-server
+  shard addresses that survive, and the wire secret.  Survivors agree
+  on a new epoch by consensus (every live rank files a proposal naming
+  the dead; the lowest live rank commits the union), and the epoch
+  counter **fences** stale traffic — every PS wire frame carries the
+  sender's epoch and servers reject mismatches, so a half-dead or
+  returning rank can never smuggle a gradient from a previous
+  incarnation into the current run.
+* Scale-up: a restarted rank files a **join request** once its process
+  is up (imports done, kvstore constructed); the survivors admit it at
+  the next checkpoint boundary by committing an epoch that re-includes
+  it.  The joiner's remaining warm-up (checkpoint restore, program
+  compile) runs AFTER admission, covered by the survivors' bounded
+  sync-round retries — size ``MXNET_DEAD_RANK_TIMEOUT`` so that ~6×
+  its value exceeds the worst-case restore+compile, or the survivors
+  will give up on the warming joiner.
+
+The data plane (who re-scatters what) lives in ``kvstore.DistKVStore
+.remesh`` and ``Module``/``fit`` — see README "Elastic training".
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+from .base import MXNetError, get_env
+
+__all__ = ["DeadRankError", "Membership", "elastic_enabled",
+           "heartbeat_interval", "dead_rank_timeout"]
+
+_EPOCH_PREFIX = "epoch-"
+_PROPOSE_PREFIX = "propose-"
+_JOIN_PREFIX = "join-"
+
+
+def _validated_env(name: str, minimum=None, maximum=None):
+    """Read a declared liveness env var with loud at-construction
+    validation (the MXNET_CKPT_* pattern): garbage or out-of-range
+    values raise instead of silently mis-tuning failure detection."""
+    from . import config
+
+    var = config.describe(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return var.default
+    try:
+        val = var.dtype(raw)
+    except (TypeError, ValueError):
+        raise MXNetError(
+            f"invalid {name}={raw!r}: expected {var.dtype.__name__}.  "
+            f"{var.doc.splitlines()[0]}")
+    if minimum is not None and val < minimum:
+        raise MXNetError(f"invalid {name}={val!r}: must be >= {minimum}")
+    if maximum is not None and val > maximum:
+        raise MXNetError(f"invalid {name}={val!r}: must be <= {maximum}")
+    return val
+
+
+def heartbeat_interval() -> float:
+    """Seconds between heartbeat-file touches — the ONE knob both the
+    kvstore heartbeat writer and the liveness scanners read
+    (``MXNET_HEARTBEAT_INTERVAL``; the legacy
+    ``MXNET_KVSTORE_HEARTBEAT_INTERVAL`` is honored as a fallback)."""
+    if "MXNET_HEARTBEAT_INTERVAL" in os.environ:
+        return float(_validated_env("MXNET_HEARTBEAT_INTERVAL",
+                                    minimum=0.01))
+    return get_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", 1.0, float)
+
+
+def dead_rank_timeout() -> float:
+    """Heartbeat-staleness threshold in seconds
+    (``MXNET_DEAD_RANK_TIMEOUT``) — shared by ``get_num_dead_node``,
+    the elastic barrier's verdict, and the PS sync-round bound."""
+    return float(_validated_env("MXNET_DEAD_RANK_TIMEOUT", minimum=0.1))
+
+
+def elastic_enabled() -> bool:
+    """``MXNET_ELASTIC=1`` — loudly validated."""
+    val = _validated_env("MXNET_ELASTIC")
+    if val not in (0, 1):
+        raise MXNetError(f"invalid MXNET_ELASTIC={val!r}: must be 0 or 1")
+    return bool(val)
+
+
+class DeadRankError(MXNetError):
+    """A peer is confirmed dead: barrier-timeout/transport-failure PLUS
+    heartbeat staleness.  Raised out of ``barrier()`` / sync push/pull
+    instead of an infinite hang; ``fit`` catches it to re-mesh and
+    resume (see BaseModule.fit).  ``dead_ranks`` is the sorted list of
+    confirmed-dead ranks, ``epoch`` the membership epoch the verdict
+    was reached at."""
+
+    def __init__(self, dead_ranks: Sequence[int], epoch: int = 0,
+                 detail: str = ""):
+        self.dead_ranks = sorted(int(r) for r in dead_ranks)
+        self.epoch = int(epoch)
+        msg = (f"rank(s) {self.dead_ranks} confirmed dead at membership "
+               f"epoch {self.epoch}")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+def _atomic_write_json(path: str, obj: Dict) -> None:
+    from .checkpoint import atomic_write_bytes
+
+    atomic_write_bytes(path, json.dumps(obj).encode())
+    try:
+        os.chmod(path, 0o600)  # the epoch record carries the wire secret
+    except OSError:
+        pass
+
+
+def _commit_json_exclusive(path: str, obj: Dict) -> bool:
+    """Atomically create ``path`` with ``obj`` ONLY if it does not
+    exist yet (write tmp + ``os.link``, which fails on an existing
+    target) — the epoch-commit primitive.  A plain atomic-replace
+    would let two ranks that each (wrongly) convicted the other both
+    commit the same epoch number, last-writer-wins: split brain.  With
+    exclusive create exactly one commit wins and the loser re-reads
+    the winner's record.  Returns False when someone else won."""
+    tmp = f"{path}.commit.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        os.chmod(tmp, 0o600)
+    except OSError:
+        pass
+    try:
+        os.link(tmp, path)
+        return True
+    except FileExistsError:
+        return False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+class Membership:
+    """File-based membership ledger + epoch consensus.
+
+    Lives in ``<heartbeat_dir>/membership/``.  Files:
+
+    - ``epoch-<n>.json`` — committed membership record: ``{"epoch",
+      "active", "world", "addrs", "secret", "wall_time"}``.  The current
+      membership is the highest ``n``.  Written atomically; only ever
+      appended (a new epoch never rewrites an old record), so readers
+      can't observe a torn transition.
+    - ``propose-<n>-<rank>.json`` — rank's proposal to leave epoch
+      ``n``, naming the ranks it believes dead.  Consensus: every live
+      rank of epoch ``n`` must file (or itself go heartbeat-stale, in
+      which case it joins the dead set); the LOWEST live rank commits
+      ``epoch-<n+1>.json`` with the union of the proposed dead removed.
+    - ``join-<rank>.json`` — a warmed-up returning rank asking to be
+      re-admitted; survivors admit at the next checkpoint boundary by
+      committing an epoch that includes it, then the joiner removes its
+      request.
+    """
+
+    def __init__(self, root: str, rank: int):
+        self.dir = os.path.join(root, "membership")
+        os.makedirs(self.dir, exist_ok=True)
+        self.rank = int(rank)
+        self._log = logging.getLogger("mxnet_tpu.elastic")
+
+    # -- record I/O ----------------------------------------------------
+    def _epoch_path(self, n: int) -> str:
+        return os.path.join(self.dir, f"{_EPOCH_PREFIX}{n:06d}.json")
+
+    def current_epoch(self) -> int:
+        """Highest committed epoch number (-1: no ledger yet)."""
+        best = -1
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return best
+        for name in names:
+            if name.startswith(_EPOCH_PREFIX) and name.endswith(".json"):
+                stem = name[len(_EPOCH_PREFIX):-5]
+                if stem.isdigit():
+                    best = max(best, int(stem))
+        return best
+
+    def read(self, epoch: Optional[int] = None) -> Optional[Dict]:
+        """The committed record for ``epoch`` (default: current)."""
+        n = self.current_epoch() if epoch is None else int(epoch)
+        if n < 0:
+            return None
+        try:
+            with open(self._epoch_path(n)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return rec
+
+    def bootstrap(self, active: Sequence[int], world: int,
+                  addrs: Dict[int, Sequence], secret: bytes) -> Dict:
+        """Rank 0 commits epoch 0 at launch (idempotent: an existing
+        ledger — e.g. a relaunch into the same shared dir — wins)."""
+        rec = self.read()
+        if rec is not None:
+            return rec
+        rec = {"epoch": 0, "active": sorted(int(r) for r in active),
+               "world": int(world),
+               "addrs": {str(r): list(a) for r, a in addrs.items()},
+               "secret": secret.hex(), "wall_time": time.time()}
+        if not _commit_json_exclusive(self._epoch_path(0), rec):
+            return self.read()
+        return rec
+
+    def wait_for_ledger(self, timeout: float = 120.0) -> Dict:
+        deadline = time.monotonic() + timeout
+        while True:
+            rec = self.read()
+            if rec is not None:
+                return rec
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"no membership ledger appeared in {self.dir} within "
+                    f"{timeout:.0f}s — is the elastic run actually up?")
+            time.sleep(0.2)
+
+    # -- scale-down consensus ------------------------------------------
+    def remesh(self, dead: Sequence[int], is_alive,
+               timeout: Optional[float] = None) -> Dict:
+        """Survivor-side consensus: file our proposal naming ``dead``,
+        wait until every still-live peer of the current epoch has filed
+        (peers that go heartbeat-stale mid-consensus join the dead
+        set), then the lowest live rank commits the next epoch record.
+        Returns the committed record.  ``is_alive(rank) -> bool`` is
+        the heartbeat oracle (kvstore-provided).
+        """
+        timeout = dead_rank_timeout() * 4 if timeout is None else timeout
+        rec = self.read()
+        if rec is None:
+            raise MXNetError("membership.remesh: no committed epoch record")
+        n = rec["epoch"]
+        active = [int(r) for r in rec["active"]]
+        my_dead = sorted(set(int(r) for r in dead) & set(active))
+        _atomic_write_json(
+            os.path.join(self.dir, f"{_PROPOSE_PREFIX}{n:06d}-{self.rank}.json"),
+            {"rank": self.rank, "dead": my_dead, "wall_time": time.time()})
+        deadline = time.monotonic() + timeout
+        while True:
+            committed = self.read()
+            if committed is not None and committed["epoch"] > n:
+                if self.rank not in committed["active"]:
+                    raise MXNetError(
+                        f"membership epoch {committed['epoch']} excluded "
+                        f"this live rank {self.rank} — a peer declared us "
+                        "dead (heartbeat stall?); refusing to keep training")
+                return committed
+            proposals: Dict[int, List[int]] = {}
+            for r in active:
+                p = os.path.join(self.dir,
+                                 f"{_PROPOSE_PREFIX}{n:06d}-{r}.json")
+                try:
+                    with open(p) as f:
+                        proposals[r] = [int(x) for x in json.load(f)["dead"]]
+                except (OSError, ValueError):
+                    continue
+            all_dead = set(my_dead)
+            for d in proposals.values():
+                all_dead.update(d)
+            # a peer that neither proposed nor heartbeats is dead too
+            silent = [r for r in active
+                      if r not in proposals and r not in all_dead
+                      and not is_alive(r)]
+            all_dead.update(silent)
+            survivors = [r for r in active if r not in all_dead]
+            if self.rank not in survivors:
+                raise MXNetError(
+                    f"rank {self.rank}: every peer considers us dead — "
+                    "refusing to keep training")
+            if all(r in proposals for r in survivors):
+                if self.rank == min(survivors):
+                    new = {
+                        "epoch": n + 1, "active": survivors,
+                        "world": rec["world"],
+                        "addrs": {k: v for k, v in rec["addrs"].items()
+                                  if int(k) in survivors},
+                        "secret": rec["secret"],
+                        "wall_time": time.time(),
+                    }
+                    # exclusive create: if a partitioned peer that
+                    # (wrongly) convicted US raced its own commit in,
+                    # we LOSE, loop, re-read, and hit the excluded-
+                    # survivor guard above — never split brain
+                    if _commit_json_exclusive(self._epoch_path(n + 1),
+                                              new):
+                        self._log.warning(
+                            "[elastic] committed membership epoch %d: "
+                            "active=%s (dead: %s)", n + 1, survivors,
+                            sorted(all_dead))
+                        return new
+                # non-leader (or lost the commit race): wait/re-read
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"membership consensus for epoch {n + 1} timed out "
+                    f"after {timeout:.0f}s (survivors={survivors}, "
+                    f"proposals from {sorted(proposals)})")
+            time.sleep(0.1)
+
+    # -- scale-up ------------------------------------------------------
+    def request_join(self) -> None:
+        """A warmed-up returning rank asks to be re-admitted."""
+        _atomic_write_json(
+            os.path.join(self.dir, f"{_JOIN_PREFIX}{self.rank}.json"),
+            {"rank": self.rank, "wall_time": time.time()})
+
+    def pending_joins(self, max_age: Optional[float] = None) -> List[int]:
+        """Ranks with an open join request.
+
+        Liveness of a WAITING joiner is the freshness of its request
+        file (the joiner refreshes it every heartbeat interval while it
+        waits) — NOT the heartbeat file: a joiner only starts
+        heartbeating once admitted, because re-animating the dead
+        incarnation's heartbeat would mask the very staleness the
+        survivors' verdict needs (the incarnation race).  ``max_age``
+        filters out a crashed joiner's stale request so it can't grow
+        the sync-round quorum."""
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        active = set((self.read() or {}).get("active", []))
+        now = time.time()
+        for name in names:
+            if name.startswith(_JOIN_PREFIX) and name.endswith(".json"):
+                stem = name[len(_JOIN_PREFIX):-5]
+                if stem.isdigit() and int(stem) not in active:
+                    if max_age is not None:
+                        try:
+                            age = now - os.path.getmtime(
+                                os.path.join(self.dir, name))
+                        except OSError:
+                            continue
+                        if age > max_age:
+                            continue
+                    out.append(int(stem))
+        return sorted(out)
+
+    def admit(self, ranks: Sequence[int],
+              addrs: Optional[Dict[int, Sequence]] = None) -> Dict:
+        """Survivor leader: commit the next epoch re-including
+        ``ranks``.  ``addrs`` may extend the shard address map (a
+        joiner hosting a fresh PS shard); by default the surviving
+        shard set is unchanged — the joiner participates as a client
+        (weights stay on the surviving shards)."""
+        rec = self.read()
+        if rec is None:
+            raise MXNetError("membership.admit: no committed epoch record")
+        n = rec["epoch"]
+        new_addrs = dict(rec["addrs"])
+        for r, a in (addrs or {}).items():
+            new_addrs[str(r)] = list(a)
+        new = {"epoch": n + 1,
+               "active": sorted(set(rec["active"]) | set(int(r) for r in ranks)),
+               "world": rec["world"], "addrs": new_addrs,
+               "secret": rec["secret"], "wall_time": time.time()}
+        if not _commit_json_exclusive(self._epoch_path(n + 1), new):
+            # lost a commit race (e.g. a concurrent scale-down) — the
+            # committed record wins; the caller re-admits at the next
+            # boundary if these ranks are still waiting
+            won = self.read()
+            raise MXNetError(
+                f"admit of {sorted(ranks)} lost the epoch-{n + 1} commit "
+                f"race to {won and won['active']}; retry next boundary")
+        self._log.warning("[elastic] committed membership epoch %d: "
+                          "re-admitted %s (active=%s)", n + 1,
+                          sorted(ranks), new["active"])
+        return new
+
+    def clear_join(self, rank: Optional[int] = None) -> None:
+        r = self.rank if rank is None else int(rank)
+        try:
+            os.remove(os.path.join(self.dir, f"{_JOIN_PREFIX}{r}.json"))
+        except OSError:
+            pass
+
+    def await_epoch(self, above: int, timeout: float = 600.0) -> Dict:
+        """Block until an epoch > ``above`` commits; returns its record
+        (the joiner's admission wait)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            rec = self.read()
+            if rec is not None and rec["epoch"] > above:
+                return rec
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"no membership epoch above {above} committed within "
+                    f"{timeout:.0f}s — joiner was never admitted "
+                    "(survivor not checkpointing?)")
+            time.sleep(0.2)

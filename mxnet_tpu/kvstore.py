@@ -35,10 +35,12 @@ import numpy as np
 from . import comm as _comm
 from . import profiler as _prof
 from .base import MXNetError
+from .elastic import (DeadRankError, Membership, dead_rank_timeout,
+                      elastic_enabled, heartbeat_interval)
 from .ndarray import NDArray
 from . import optimizer as opt
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "create", "DeadRankError"]
 
 
 def _fill_outs(cur, olist):
@@ -145,13 +147,13 @@ class KVStore:
 
             multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")
 
-    def get_num_dead_node(self, node_id=0, timeout=60):
+    def get_num_dead_node(self, node_id=0, timeout=None):
         """Count peers considered dead.  ``timeout`` is the heartbeat-
-        staleness threshold in SECONDS (same default and meaning as
-        DistKVStore, which actually reads heartbeat files).  Here the
-        JAX runtime handles liveness — a missing peer fails
-        collectives — so report 0 while healthy (reference:
-        kvstore.h:242)."""
+        staleness threshold in SECONDS — default
+        ``MXNET_DEAD_RANK_TIMEOUT`` (same meaning as DistKVStore, which
+        actually reads heartbeat files).  Here the JAX runtime handles
+        liveness — a missing peer fails collectives — so report 0 while
+        healthy (reference: kvstore.h:242)."""
         return 0
 
     def send_command_to_servers(self, head, body):
@@ -208,7 +210,10 @@ def _maybe_init_distributed(kv_type: str):
     if coord or "JAX_COORDINATOR_ADDRESS" in os.environ or \
             "COORDINATOR_ADDRESS" in os.environ:
         try:
-            jax.distributed.initialize(**kwargs)
+            if kwargs and elastic_enabled():
+                _elastic_init_distributed(**kwargs)
+            else:
+                jax.distributed.initialize(**kwargs)
         except RuntimeError as exc:
             if "already" in str(exc).lower():
                 pass  # launcher/driver initialized it — fine
@@ -231,6 +236,53 @@ def _maybe_init_distributed(kv_type: str):
                     "kvstore %r: jax.distributed.initialize failed (%s); "
                     "single configured process — proceeding locally.",
                     kv_type, exc)
+
+
+def _elastic_init_distributed(coordinator_address, num_processes,
+                              process_id):
+    """Wire the JAX distributed runtime for an ELASTIC run.
+
+    Elastic runs own their liveness plane (file heartbeats + the
+    DeadRankError verdict), so the JAX coordination service must never
+    reach a verdict of its own: its error delivery is a process ABORT
+    (xla client.h LOG(FATAL)) that would kill the SURVIVOR ~100s after
+    the very peer death it is busy recovering from, and its
+    destruction-time shutdown barrier would hang a finished survivor
+    waiting on a task that can no longer answer.  The public
+    ``jax.distributed.initialize`` exposes none of those knobs (jax
+    0.4.x); build the service/client directly with (a) effectively
+    disabled coordination heartbeat verdicts, (b) a log-only
+    missed-heartbeat callback, and (c) no shutdown-on-destruction."""
+    import logging
+
+    from jax._src import xla_bridge as _xb
+    from jax._src.distributed import global_state as _gs
+    from jax._src.lib import xla_extension as _xe
+
+    if _xb.backends_are_initialized():
+        raise RuntimeError(
+            "elastic distributed init must run before any JAX "
+            "computation (import mxnet_tpu and create the kvstore "
+            "first)")
+    if _gs.client is not None:
+        raise RuntimeError("distributed runtime initialized twice")
+    _gs.coordinator_address = coordinator_address
+    _gs.process_id = process_id
+    _gs.num_processes = num_processes
+    port = coordinator_address.rsplit(":", 1)[1]
+    if process_id == 0 and _gs.service is None:
+        _gs.service = _xe.get_distributed_runtime_service(
+            f"[::]:{port}", num_processes,
+            heartbeat_interval=10, max_missing_heartbeats=1_000_000)
+    _gs.client = _xe.get_distributed_runtime_client(
+        coordinator_address, process_id, init_timeout=300,
+        heartbeat_interval=10, max_missing_heartbeats=1_000_000,
+        missed_heartbeat_callback=lambda status: logging.warning(
+            "[elastic] jax coordination heartbeat report (ignored; "
+            "liveness is heartbeat-file based): %s", status),
+        shutdown_on_destruction=False, use_compression=True)
+    _gs.client.connect()
+    _gs.initialize_preemption_sync_manager()
 
 
 class TPUKVStore(KVStore):
@@ -283,6 +335,18 @@ class DistKVStore(TPUKVStore):
     def __init__(self, kv_type="dist_sync"):
         import os
 
+        from .base import get_env
+
+        # -- elastic mode (MXNET_ELASTIC=1, loudly validated) ----------
+        # Elastic runs swap the fixed-membership machinery for the
+        # survivable control plane: file-based barriers with a
+        # DeadRankError verdict, the membership-epoch ledger, and
+        # gradient traffic forced onto the reconnectable PS transport
+        # (the gloo/ICI collective context of a launch-time world
+        # cannot admit a restarted process; TCP shards can).
+        self._elastic = elastic_enabled()
+        self._join = self._elastic and bool(
+            get_env("MXNET_ELASTIC_JOIN", 0, int))
         self._async = kv_type in ("dist_async", "dist_device_async")
         # server-side sync updates (reference architecture: the updater
         # runs on the server after NumWorkers pushes, workers stateless
@@ -290,23 +354,59 @@ class DistKVStore(TPUKVStore):
         # updater, which needs no server round-trips
         self._server_sync = (not self._async and os.environ.get(
             "MXNET_KVSTORE_SYNC_ON_SERVER", "0") == "1")
+        if self._elastic and not self._async:
+            self._server_sync = True
         self._ps_server = None
         self._ps = None
+        self._ps_addrs: List[tuple] = []
+        self._ps_secret = b""
         self._sync_round: Dict[Any, int] = {}
         self._key_meta: Dict[Any, tuple] = {}  # key → (shape, dtype)
         self._needs_init_barrier = False
         self._comm: Optional[_comm.CommScheduler] = None
         self._ps_launch = None  # built lazily from comm.make_ps_launch
         self._pending_pulls: List[tuple] = []
-        super().__init__(kv_type)  # TPUKVStore wires the dist runtime
-        self._start_heartbeat()
-        if self._async or self._server_sync:
-            self._start_parameter_server()
+        self._membership: Optional[Membership] = None
+        self._epoch = 0      # current membership epoch (elastic)
+        self._eb_seq = 0     # elastic-barrier sequence within the epoch
+        # validate the unified liveness knobs LOUDLY at construction
+        # (the CKPT-vars pattern): both the heartbeat writer and every
+        # staleness scan read these
+        self._hb_interval = heartbeat_interval()
+        if self._elastic:
+            dead_rank_timeout()
+        if self._join:
+            # a returning rank: no jax.distributed (the launch-time
+            # runtime died with the old incarnation); identity comes
+            # from the launcher env, the run from the membership ledger.
+            # NO heartbeat until admitted — re-animating the dead
+            # incarnation's heartbeat file would mask the staleness the
+            # survivors' verdict depends on (the incarnation race);
+            # pre-admission liveness is the join file's freshness.
+            super(TPUKVStore, self).__init__(kv_type)
+            self.mesh_plan = None
+            self._rank = get_env("MXNET_WORKER_ID", 0, int)
+            self._num_workers = 1  # fixed by the admission record below
+            self._active = [self._rank]
+            self._hb_dir = os.environ.get("MXNET_KVSTORE_HEARTBEAT_DIR")
+            self._join_run()
+            self._start_heartbeat()
+        else:
+            super().__init__(kv_type)  # TPUKVStore wires the dist runtime
+            self._rank = jax.process_index()
+            self._num_workers = jax.process_count()
+            self._active = list(range(self._num_workers))
+            self._start_heartbeat()
+            if self._async or self._server_sync:
+                self._start_parameter_server()
+            if self._elastic:
+                self._init_membership()
         # the gradient comm scheduler: pushes coalesce into buckets
         # consumed by a background thread, so the allgather / PS round-
         # trip (and its D2H staging) overlaps the rest of the step.
         # MXNET_KVSTORE_OVERLAP=0 restores the blocking per-key path.
-        if jax.process_count() > 1 and _comm.overlap_enabled():
+        if (jax.process_count() > 1 or self._ps is not None) \
+                and _comm.overlap_enabled():
             # a COLLECTIVE transport must launch buckets in submission
             # order — every rank's comm thread has to issue the same
             # collective sequence, and a priority pop whose heap
@@ -315,6 +415,30 @@ class DistKVStore(TPUKVStore):
             self._comm = _comm.CommScheduler(
                 self._comm_launch, strict_order=(self._ps is None),
                 name=f"mxnet_tpu-kvstore-comm-r{self.rank}")
+
+    # -- identity (stable across re-mesh; the base class asks jax) -----
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        """ACTIVE worker count — shrinks/grows with the membership
+        epoch in elastic mode (the sync-round quorum and barrier
+        width), launch-time world otherwise."""
+        return self._num_workers
+
+    @property
+    def active_ranks(self) -> List[int]:
+        return list(self._active)
+
+    @property
+    def membership(self) -> Optional[Membership]:
+        return self._membership
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
 
     # -- parameter servers (reference: kvstore_dist_server.h) ----------
     def _start_parameter_server(self):
@@ -364,9 +488,16 @@ class DistKVStore(TPUKVStore):
                 probe.close()
         except OSError:
             pass
+        # elastic: sync-round waits must be bounded by the dead-rank
+        # timeout (+margin) so a dead peer surfaces as an error frame —
+        # converted to the DeadRankError verdict — instead of the
+        # non-elastic 600 s debug ceiling
+        sync_wait = (max(2.0 * dead_rank_timeout(), 5.0)
+                     if self._elastic else 600.0)
         self._ps_server = ParameterServer(
             host=host_b.decode(), secret=secret,
-            num_workers=self.num_workers, sync=self._server_sync)
+            num_workers=self.num_workers, sync=self._server_sync,
+            sync_wait_timeout=sync_wait)
 
         # allgather every shard's (port, host) — ordered by rank
         msg = _np.zeros(65, _np.int32)
@@ -378,6 +509,8 @@ class DistKVStore(TPUKVStore):
         for row in all_msgs:
             h = bytes(row[1:][row[1:] > 0].astype(_np.uint8)).decode()
             addrs.append((h or "127.0.0.1", int(row[0])))
+        self._ps_addrs = addrs
+        self._ps_secret = secret
         self._ps = ShardedPSClient(addrs, secret=secret, worker=self.rank)
 
     def init(self, key, value):
@@ -405,12 +538,12 @@ class DistKVStore(TPUKVStore):
                     # lockstep gather: EVERY rank must participate in
                     # the collective even though only rank 0 pushes
                     arr = gather_global(v)
-                elif self.rank == 0:
+                elif self.rank == 0 and not self._join:
                     arr = (v.asnumpy() if isinstance(v, NDArray)
                            else np.asarray(v))
                 else:
                     arr = None
-                if self.rank == 0:
+                if self.rank == 0 and not self._join:
                     self._key_meta[k] = (arr.shape, arr.dtype)
                     self._ps.init(k, arr)
                 else:
@@ -429,8 +562,12 @@ class DistKVStore(TPUKVStore):
             # the rendezvous (no pull/push before rank 0's init landed)
             # is deferred to the first non-init op: Module init calls
             # init() once per parameter, and a barrier per key would be
-            # hundreds of cross-host collectives at startup
-            self._needs_init_barrier = True
+            # hundreds of cross-host collectives at startup.  A
+            # re-joining rank skips both push and rendezvous: the
+            # weights already live on the surviving shards (its inits
+            # would be first-wins no-ops) and the survivors are
+            # mid-training, not waiting at an init barrier.
+            self._needs_init_barrier = not self._join
             return
         if jax.process_count() > 1:
             # sync path: rank 0's init wins for ALL workers (the
@@ -521,10 +658,13 @@ class DistKVStore(TPUKVStore):
                                                             "nbytes", 0)),
                                        "sync": self._server_sync}):
                     host = np.asarray(merged)
-                    if self._server_sync:
-                        self._ps.push_sync(k, host)
-                    else:
-                        self._ps.push(k, host)
+                    try:
+                        if self._server_sync:
+                            self._ps.push_sync(k, host)
+                        else:
+                            self._ps.push(k, host)
+                    except (MXNetError, OSError) as exc:
+                        self._verdict(exc)
             return
         if jax.process_count() == 1:
             return super().push(key, value, priority)
@@ -621,27 +761,34 @@ class DistKVStore(TPUKVStore):
         if self._ps is not None:
             self._init_barrier()
             assert out is not None
-            if self._comm is not None:
-                # quiesce the WHOLE scheduler, not just these keys'
-                # buckets: a main-thread wire op may not take an
-                # in-flight window slot while the comm thread still
-                # holds undrained finishers on the same connections —
-                # comm blocked in _begin + main blocked behind comm's
-                # tickets would mutually stall until the 630s timeouts
-                self._comm.drain()
-            keys, outs = _key_value_lists(key, out)
-            for k, olist in zip(keys, outs):
-                shape, dtype = self._key_meta.get(k, (None, None))
-                # async: current weights, no barrier.  server-sync:
-                # wait for the round this worker's pushes belong to
-                with _prof.scope("kvstore.pull", "comm",
-                                 args={"key": str(k),
-                                       "sync": self._server_sync}):
-                    cur = self._ps.pull(
-                        k, shape=shape, dtype=dtype,
-                        min_round=self._sync_round.get(k, 0)
-                        if self._server_sync else 0)
-                _fill_outs(cur, olist)
+            try:
+                if self._comm is not None:
+                    # quiesce the WHOLE scheduler, not just these keys'
+                    # buckets: a main-thread wire op may not take an
+                    # in-flight window slot while the comm thread still
+                    # holds undrained finishers on the same connections —
+                    # comm blocked in _begin + main blocked behind comm's
+                    # tickets would mutually stall until the 630s timeouts
+                    self._comm.drain()
+                keys, outs = _key_value_lists(key, out)
+                for k, olist in zip(keys, outs):
+                    shape, dtype = self._key_meta.get(k, (None, None))
+                    # async: current weights, no barrier.  server-sync:
+                    # wait for the round this worker's pushes belong to
+                    with _prof.scope("kvstore.pull", "comm",
+                                     args={"key": str(k),
+                                           "sync": self._server_sync}):
+                        cur = self._retry_pull(
+                            lambda k=k, shape=shape, dtype=dtype:
+                            self._ps.pull(
+                                k, shape=shape, dtype=dtype,
+                                min_round=self._sync_round.get(k, 0)
+                                if self._server_sync else 0))
+                    _fill_outs(cur, olist)
+            except (MXNetError, OSError) as exc:
+                # a dead shard / timed-out round: the failure verdict —
+                # DeadRankError when a peer's heartbeat is stale
+                self._verdict(exc)
             return
         if self._comm is not None:
             # allgather mode: the comm thread runs the updater into
@@ -678,22 +825,29 @@ class DistKVStore(TPUKVStore):
         if not self._pending_pulls:
             return
         pending, self._pending_pulls = self._pending_pulls, []
-        if self._comm is not None:  # close() lands pulls before nulling
-            if self._ps is not None:
-                # full quiesce before main-thread wire ops — see pull()
-                self._comm.drain()
-            else:
-                for k, _olist, _mr in pending:
-                    self._comm.wait(k)
+        try:
+            if self._comm is not None:  # close() lands pulls first
+                if self._ps is not None:
+                    # full quiesce before main-thread wire ops — pull()
+                    self._comm.drain()
+                else:
+                    for k, _olist, _mr in pending:
+                        self._comm.wait(k)
+        except (MXNetError, OSError) as exc:
+            self._verdict(exc)
         if self._ps is not None:
             specs = []
             for k, _olist, mr in pending:
                 shape, dtype = self._key_meta.get(k, (None, None))
                 specs.append((k, shape, dtype, mr))
-            with _prof.scope("kvstore.pull", "comm",
-                             args={"keys": len(specs), "batched": True,
-                                   "sync": self._server_sync}):
-                arrs = self._ps.pull_multi(specs)
+            try:
+                with _prof.scope("kvstore.pull", "comm",
+                                 args={"keys": len(specs), "batched": True,
+                                       "sync": self._server_sync}):
+                    arrs = self._retry_pull(
+                        lambda: self._ps.pull_multi(specs))
+            except (MXNetError, OSError) as exc:
+                self._verdict(exc)
             for (k, olist, _mr), cur in zip(pending, arrs):
                 _fill_outs(cur, olist)
             return
@@ -708,9 +862,332 @@ class DistKVStore(TPUKVStore):
         two threads interleaving collectives across ranks in different
         orders would deadlock or cross-sum."""
         if self._comm is not None:
-            self._comm.drain()
+            try:
+                self._comm.drain()
+            except (MXNetError, OSError) as exc:
+                self._verdict(exc)
         if self._pending_pulls:
             self.drain_pulls()
+
+    # -- elastic membership / re-mesh ----------------------------------
+    def _init_membership(self):
+        """Launch-time ledger: rank 0 commits membership epoch 0
+        (active = every launched rank, the surviving shard addresses,
+        the wire secret) into the shared heartbeat dir."""
+        if not self._hb_dir:
+            if self.num_workers > 1:
+                raise MXNetError(
+                    "MXNET_ELASTIC=1 needs the launcher's shared "
+                    "MXNET_KVSTORE_HEARTBEAT_DIR (heartbeats + the "
+                    "membership ledger live there) — use tools/launch.py "
+                    "or tools/chaos_drill.py")
+            return
+        self._membership = Membership(self._hb_dir, self.rank)
+        if self.rank == 0:
+            self._membership.bootstrap(
+                active=range(self.num_workers), world=self.num_workers,
+                addrs={r: a for r, a in enumerate(self._ps_addrs)},
+                secret=self._ps_secret)
+
+    def _join_run(self):
+        """Returning-rank admission: discover the live run from the
+        ledger, file a join request (we are warm — process up, imports
+        done), wait until the survivors commit an epoch that includes
+        us at a checkpoint boundary, then attach to the surviving
+        shards under that epoch.  Weights stay on the shards; our
+        caller restores its own training state from the last committed
+        checkpoint (``fit(resume='auto')``)."""
+        import time
+
+        if not self._hb_dir:
+            raise MXNetError("MXNET_ELASTIC_JOIN=1 needs "
+                             "MXNET_KVSTORE_HEARTBEAT_DIR")
+        self._membership = Membership(self._hb_dir, self.rank)
+        rec = self._membership.wait_for_ledger()
+        # only attach to an epoch committed AFTER our request: the
+        # ledger we find may still list our DEAD incarnation as active
+        # (we restarted before the survivors convicted it) — joining it
+        # would resurrect the half-dead membership the verdict is busy
+        # tearing down
+        e0 = rec["epoch"]
+        self._membership.request_join()
+        deadline = time.monotonic() + 600.0
+        while not (rec["epoch"] > e0 and self.rank in rec["active"]):
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"rank {self.rank} was never re-admitted (no epoch "
+                    f"above {e0} including it within 600s — survivor "
+                    "not checkpointing?)")
+            # refresh the request: its mtime is our pre-admission
+            # liveness signal (see Membership.pending_joins)
+            self._membership.request_join()
+            time.sleep(min(1.0, self._hb_interval))
+            rec = self._membership.read() or rec
+        self._membership.clear_join()
+        self._server_sync = True
+        self._attach_record(rec)
+        _prof.inc_counter("elastic.joins")
+        import logging
+
+        logging.getLogger("mxnet_tpu.elastic").warning(
+            "[elastic] rank %d re-admitted at membership epoch %d "
+            "(active=%s)", self.rank, self._epoch, self._active)
+
+    def _attach_record(self, record):
+        """Point the data plane at a committed membership record:
+        rebuild the sharded client over the surviving shard addresses
+        and advance every shard to the record's epoch (idempotent —
+        every member sends it, first one wins)."""
+        from .ps import ShardedPSClient
+
+        active = [int(r) for r in record["active"]]
+        addrs = [tuple(record["addrs"][k])
+                 for k in sorted(record["addrs"], key=int)]
+        secret = bytes.fromhex(record["secret"])
+        if self._ps is not None:
+            self._ps.close()
+        self._ps = ShardedPSClient(addrs, secret=secret, worker=self.rank)
+        self._ps_addrs = addrs
+        self._ps_secret = secret
+        self._ps_launch = None  # lazily rebuilt against the new client
+        self._ps.remesh(int(record["epoch"]), len(active),
+                        reset=bool(record.get("_reset")))
+        self._active = active
+        self._num_workers = len(active)
+        self._epoch = int(record["epoch"])
+        self._eb_seq = 0
+        self._sync_round = {}
+        self._pending_pulls = []
+        self._needs_init_barrier = False
+
+    def remesh(self, record, restored_params=None):
+        """Install a committed membership record (from
+        ``Membership.remesh`` consensus or ``admit``).
+
+        Scale-down (``restored_params`` given — kv key → host array
+        from the last committed checkpoint): shards are RESET and the
+        lowest surviving rank re-scatters every key from the snapshot,
+        gated by an elastic barrier so no survivor pushes into a
+        half-initialized shard set.  Scale-up (no snapshot): the store
+        is live and correct; only the epoch/quorum advance.  Either way
+        the comm scheduler is rebuilt (the old one may be poisoned by
+        the very failure that triggered the re-mesh) and sync-round
+        clocks restart at the new epoch."""
+        if self._comm is not None:
+            try:
+                self._comm.close()
+            except Exception:  # noqa: BLE001 — poisoned scheduler
+                pass
+            self._comm = None
+        record = dict(record)
+        record["_reset"] = restored_params is not None
+        self._attach_record(record)
+        if restored_params is not None:
+            import numpy as _np
+
+            if self.rank == min(self._active):
+                for k, v in restored_params.items():
+                    host = _np.asarray(v)
+                    self._key_meta[k] = (host.shape, host.dtype)
+                    self._ps.init(k, host)
+            else:
+                for k, v in restored_params.items():
+                    shape = tuple(v.shape)
+                    self._key_meta[k] = (shape, _np.dtype(v.dtype))
+                    self._ps.record_size(
+                        k, int(_np.prod(shape)) if shape else 1)
+            self._elastic_barrier()  # re-scatter visible everywhere
+        else:
+            import numpy as _np
+
+            for k, (shape, _dtype) in self._key_meta.items():
+                self._ps.record_size(
+                    k, int(_np.prod(shape)) if shape else 1)
+        if _comm.overlap_enabled():
+            self._comm = _comm.CommScheduler(
+                self._comm_launch, strict_order=False,
+                name=f"mxnet_tpu-kvstore-comm-r{self.rank}-e{self._epoch}")
+        _prof.inc_counter("elastic.remesh")
+
+    def dead_ranks(self, timeout=None, ranks=None) -> List[int]:
+        """Heartbeat-staleness scan → the sorted list of dead ranks.
+
+        ``timeout`` defaults to ``MXNET_DEAD_RANK_TIMEOUT``.  Scans the
+        active membership (elastic) or the launch world.  A rank is
+        dead when its heartbeat file is missing or older than the
+        threshold; mtimes in the FUTURE (writer clock ahead of ours on
+        a shared filesystem) count as fresh — clock skew must never
+        accuse a live rank.  Our own rank is alive by construction."""
+        import os
+        import time
+
+        if not self._hb_dir:
+            return []
+        if timeout is None:
+            timeout = dead_rank_timeout()
+        if ranks is None:
+            ranks = self._active if self._elastic \
+                else range(self.num_workers)
+        now = time.time()
+        dead = []
+        for r in ranks:
+            if r == self.rank:
+                continue
+            path = os.path.join(self._hb_dir, f"hb_{r}")
+            try:
+                age = now - os.path.getmtime(path)
+            except OSError:
+                dead.append(r)  # never wrote a heartbeat
+                continue
+            if max(age, 0.0) > timeout:
+                dead.append(r)
+        return sorted(dead)
+
+    def check_peers(self):
+        """The failure verdict as a poll: raise DeadRankError when any
+        active peer's heartbeat is stale."""
+        dead = self.dead_ranks()
+        if dead:
+            raise DeadRankError(dead, self._epoch,
+                                detail="heartbeat staleness scan")
+
+    def _verdict(self, exc, reraise=True):
+        """Convert a transport failure into the actionable verdict.
+
+        A socket error / sync-round timeout / poisoned scheduler plus a
+        stale peer heartbeat == a dead rank: raise DeadRankError (fit
+        re-meshes).  When no peer is stale yet, wait up to the
+        dead-rank timeout for the heartbeat evidence to settle (the
+        failure usually precedes staleness by one scan interval); if
+        every peer stays live the failure was NOT a death —
+        ``reraise`` re-raises it untouched, else return so the caller
+        may retry (a round stalled behind a live-but-warming peer,
+        e.g. a freshly re-admitted rank compiling its program, heals
+        itself)."""
+        import time
+
+        if not self._elastic or isinstance(exc, DeadRankError):
+            raise exc
+        dead = self.dead_ranks()
+        if dead:
+            raise DeadRankError(
+                dead, self._epoch, detail=str(exc)[:200]) from exc
+        # only failures that LOOK like a peer problem are worth waiting
+        # out the staleness window for; a deterministic protocol error
+        # (uninitialized key, HMAC refusal, ...) must fail now, not
+        # after minutes of heartbeat polling
+        msg = str(exc)
+        plausibly_death = (not isinstance(exc, MXNetError)
+                           and isinstance(exc, OSError)) or any(
+            tok in msg for tok in (
+                "timed out", "dead", "closed", "reset", "stuck",
+                "cannot reach", "Connection", "re-meshed",
+                "stale membership epoch"))
+        if not plausibly_death:
+            raise exc
+        deadline = time.monotonic() + dead_rank_timeout() \
+            + 2.0 * self._hb_interval
+        while True:
+            dead = self.dead_ranks()
+            if dead:
+                raise DeadRankError(
+                    dead, self._epoch, detail=str(exc)[:200]) from exc
+            if time.monotonic() > deadline:
+                if reraise:
+                    raise exc
+                return
+            time.sleep(min(0.2, self._hb_interval / 2.0))
+
+    def _retry_pull(self, op, attempts=3):
+        """Run a (idempotent) pull op, retrying a bounded number of
+        times while every peer stays heartbeat-live — a sync round
+        stalled behind a live-but-slow member (straggler, warming
+        joiner) is a wait, not a death.  A stale peer raises the
+        DeadRankError verdict immediately."""
+        if not self._elastic:
+            return op()
+        n = 0
+        while True:
+            try:
+                return op()
+            except (MXNetError, OSError) as exc:
+                if isinstance(exc, DeadRankError):
+                    raise
+                n += 1
+                if n >= attempts:
+                    raise
+                # raises DeadRankError when someone is actually dead;
+                # returns (→ retry) when everyone is provably alive
+                self._verdict(exc, reraise=False)
+                _prof.inc_counter("kvstore.pull_retries")
+
+    def _elastic_barrier(self):
+        """File-stamp rendezvous among the ACTIVE ranks, with the
+        failure verdict instead of an uninterruptible collective: each
+        rank stamps ``eb_<epoch>_<seq>_<rank>``; waiting ends when
+        every active peer stamped, or raises DeadRankError when a
+        missing peer's heartbeat goes stale (barrier-timeout +
+        heartbeat-staleness).  A live-but-slow peer only draws a
+        watchdog log — a straggler is not a death."""
+        import os
+        import time
+
+        from .base import get_env
+
+        self._sync_comm()
+        active = list(self._active)
+        if len(active) <= 1 or not self._hb_dir:
+            return
+        self._eb_seq += 1
+        seq, epoch = self._eb_seq, self._epoch
+        # GC our seq-2 stamp — NOT seq-1: unlike the collective
+        # barrier's watchdog stamps, these files ARE the rendezvous.  A
+        # peer can still be inside barrier seq-1 scanning for our stamp
+        # while we enter seq (peers lag by at most one barrier — we
+        # could not have passed seq-1 without everyone's stamp); only
+        # once everyone stamped seq has everyone PASSED seq-1, so the
+        # seq-2 stamp is provably unobserved-no-more
+        try:
+            os.remove(os.path.join(self._hb_dir,
+                                   f"eb_{epoch}_{seq - 2}_{self.rank}"))
+        except OSError:
+            pass
+        stamp = os.path.join(self._hb_dir, f"eb_{epoch}_{seq}_{self.rank}")
+        with open(stamp, "w") as f:
+            f.write(str(time.time()))
+        watchdog = get_env("MXNET_WATCHDOG_DEADLINE", 60.0, float)
+        t0 = time.perf_counter()
+        warned = False
+        while True:
+            missing = [r for r in active if r != self.rank and
+                       not os.path.exists(os.path.join(
+                           self._hb_dir, f"eb_{epoch}_{seq}_{r}"))]
+            if not missing:
+                break
+            dead = self.dead_ranks(ranks=missing)
+            if dead:
+                _prof.inc_counter("watchdog.barrier_timeouts")
+                raise DeadRankError(
+                    dead, epoch,
+                    detail=f"elastic barrier #{seq} abandoned after "
+                           f"{time.perf_counter() - t0:.1f}s")
+            if watchdog > 0 and not warned \
+                    and time.perf_counter() - t0 > watchdog:
+                warned = True
+                import logging
+
+                logging.warning(
+                    "[watchdog] elastic barrier #%d (epoch %d) open for "
+                    "%.1fs on rank %d: waiting on ranks %s (heartbeats "
+                    "still fresh)", seq, epoch, watchdog, self.rank,
+                    missing)
+                _prof.inc_counter("watchdog.barrier_timeouts")
+            time.sleep(0.02)
+        _prof.add_event("kvstore.barrier", t0,
+                        time.perf_counter() - t0, "comm",
+                        args={"seq": seq, "epoch": epoch, "elastic": True})
+        _prof.observe("kvstore.barrier_ms",
+                      (time.perf_counter() - t0) * 1e3)
 
     # -- heartbeat-based failure detection -----------------------------
     def _start_heartbeat(self):
@@ -722,13 +1199,17 @@ class DistKVStore(TPUKVStore):
         import threading
         import time
 
+        from .chaos import get_chaos
+
         self._hb_dir = os.environ.get("MXNET_KVSTORE_HEARTBEAT_DIR")
-        self._hb_interval = float(os.environ.get(
-            "MXNET_KVSTORE_HEARTBEAT_INTERVAL", "1.0"))
+        # cadence from the unified MXNET_HEARTBEAT_INTERVAL (validated
+        # in __init__); the legacy MXNET_KVSTORE_HEARTBEAT_INTERVAL
+        # still works as a fallback — see elastic.heartbeat_interval
         if not self._hb_dir:
             return
         os.makedirs(self._hb_dir, exist_ok=True)
         path = os.path.join(self._hb_dir, f"hb_{self.rank}")
+        rank = self.rank
 
         def beat():
             while True:
@@ -737,6 +1218,11 @@ class DistKVStore(TPUKVStore):
                         f.write(str(time.time()))
                 except OSError:
                     pass
+                # chaos: the delayed-heartbeat fault — go silent long
+                # enough for peers to (wrongly or rightly) convict us
+                stall = get_chaos().heartbeat_stall_s(rank=rank)
+                if stall:
+                    time.sleep(stall)
                 time.sleep(self._hb_interval)
 
         t = threading.Thread(target=beat, daemon=True,
@@ -758,6 +1244,12 @@ class DistKVStore(TPUKVStore):
 
         import jax
 
+        if self._elastic:
+            # survivable rendezvous: file stamps + the DeadRankError
+            # verdict instead of an uninterruptible collective (which
+            # could never complete once a peer died, and which a
+            # re-admitted process could never join)
+            return self._elastic_barrier()
         if jax.process_count() <= 1:
             return
         # quiesce in-flight gradient comm first: the rendezvous
@@ -848,30 +1340,22 @@ class DistKVStore(TPUKVStore):
         self._sync_comm()
         super().save_optimizer_states(fname)
 
-    def get_num_dead_node(self, node_id=0, timeout=60):
+    def get_num_dead_node(self, node_id=0, timeout=None):
         """Count workers whose heartbeat file is stale (reference:
         kvstore.h:242 / ps-lite heartbeats, kvstore_dist.h:151-160).
 
-        ``timeout`` is the staleness threshold in seconds.  Without a
-        heartbeat dir (no launcher), fall back to runtime health: JAX's
-        coordinator fails collectives on peer loss, so report 0 while
-        the runtime answers."""
-        import os
-        import time
-
+        ``timeout`` is the staleness threshold in seconds — default
+        ``MXNET_DEAD_RANK_TIMEOUT``.  Without a heartbeat dir (no
+        launcher), fall back to runtime health: JAX's coordinator fails
+        collectives on peer loss, so report 0 while the runtime
+        answers."""
         import jax
 
         if self._hb_dir:
-            now = time.time()
-            dead = 0
-            for r in range(self.num_workers):
-                path = os.path.join(self._hb_dir, f"hb_{r}")
-                try:
-                    if now - os.path.getmtime(path) > timeout:
-                        dead += 1
-                except OSError:
-                    dead += 1  # never wrote a heartbeat
-            return dead
+            # default scan set: the ACTIVE membership in elastic mode
+            # (an already-convicted rank must not count forever, and a
+            # re-admitted one must), the launch world otherwise
+            return len(self.dead_ranks(timeout=timeout))
         try:
             jax.process_count()
             return 0

@@ -121,7 +121,7 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, checkpoint=None, resume=None):
+            monitor=None, checkpoint=None, resume=None, elastic_data=None):
         """Train loop (reference: base_module.py:315 fit).
 
         Fault tolerance: pass a ``mxnet_tpu.checkpoint.CheckpointManager``
@@ -131,11 +131,29 @@ class BaseModule:
         newest committed checkpoint — parameters, optimizer state,
         lr-scheduler step, RNG, and the exact epoch/batch position of
         the data iterator — and continues as if never interrupted.
+
+        Elastic mode (``MXNET_ELASTIC=1``): the loop survives rank
+        death.  A :class:`~mxnet_tpu.elastic.DeadRankError` verdict
+        (barrier timeout / transport failure + stale heartbeat) makes
+        the survivors agree on a shrunk membership epoch, re-scatter
+        the weights from the last committed checkpoint, roll their own
+        training state back to it, and CONTINUE — no operator action.
+        A restarted rank re-joins at the next checkpoint boundary.
+        ``elastic_data(active_ranks) -> DataIter`` rebuilds this rank's
+        data shard for a new membership (keep the GLOBAL batch layout
+        fixed so batch indices stay comparable across epochs of any
+        world size); positioning is reset-and-skip to the checkpointed
+        batch, so no sample is dropped or double-counted relative to
+        the rollback point.
         """
         assert num_epoch is not None, "please specify number of epochs"
         from ..base import get_env
+        from ..chaos import get_chaos
+        from ..elastic import DeadRankError, elastic_enabled
         from ..initializer import Uniform
 
+        elastic = elastic_enabled()
+        chaos = get_chaos()
         if initializer is None:
             initializer = Uniform(0.01)
 
@@ -180,8 +198,19 @@ class BaseModule:
             checkpoint.attach(self, train_data)
             checkpoint.install_signal_handler()
             if ckpt_state is not None:
-                checkpoint.restore_training_state(self, ckpt_state,
-                                                  train_data)
+                if elastic:
+                    # the saving rank's iterator snapshot may come from
+                    # a DIFFERENT membership (other local batch size /
+                    # shard): position by batch index instead — reset
+                    # and skip through the checkpointed batch, which is
+                    # membership-invariant when the global batch layout
+                    # is fixed
+                    checkpoint.restore_training_state(self, ckpt_state,
+                                                      train_iter=None)
+                    _skip_batches(train_data, ckpt_state["nbatch"] + 1)
+                else:
+                    checkpoint.restore_training_state(self, ckpt_state,
+                                                      train_data)
                 resume_nbatch = ckpt_state["nbatch"]
 
         if validation_metric is None:
@@ -189,10 +218,16 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        kv_obj = getattr(self, "_kvstore", None)
+        self._fit_step_count = getattr(self, "_fit_step_count", 0)
+
         ################################################################
-        # training loop (reference: base_module.py:404-449)
+        # training loop (reference: base_module.py:404-449); a while
+        # loop so an elastic rollback can REWIND epoch/nbatch to the
+        # last committed checkpoint and keep going
         ################################################################
-        for epoch in range(begin_epoch, num_epoch):
+        epoch = begin_epoch
+        while epoch < num_epoch:
             tic = time.time()
             eval_metric.reset()
             # manual iteration so the step timeline can split "waiting
@@ -201,10 +236,12 @@ class BaseModule:
             # question starts from
             train_iter = iter(train_data)
             nbatch = 0
-            if epoch == begin_epoch and resume_nbatch >= 0:
+            if resume_nbatch >= 0:
                 # the restored iterator continues mid-epoch right after
                 # the checkpointed batch; keep nbatch aligned with it
                 nbatch = resume_nbatch + 1
+                resume_nbatch = -1
+            rolled_back = False
             while True:
                 with _prof.scope("io.next", "io",
                                  args={"epoch": epoch, "step": nbatch}):
@@ -216,14 +253,37 @@ class BaseModule:
                     monitor.tic()
                 if checkpoint is not None:
                     checkpoint.step_begin()
-                with _prof.scope("fit.step", "step",
-                                 args={"epoch": epoch, "step": nbatch}):
-                    self.forward_backward(data_batch)
-                    self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if checkpoint is not None:
-                    checkpoint.step_end(self, epoch=epoch, nbatch=nbatch,
-                                        train_iter=train_data)
+                try:
+                    chaos.on_step(self._fit_step_count,
+                                  rank=getattr(kv_obj, "rank", None))
+                    self._fit_step_count += 1
+                    with _prof.scope("fit.step", "step",
+                                     args={"epoch": epoch, "step": nbatch}):
+                        self.forward_backward(data_batch)
+                        self.update()
+                    self.update_metric(eval_metric, data_batch.label)
+                    if checkpoint is not None:
+                        checkpoint.step_end(self, epoch=epoch,
+                                            nbatch=nbatch,
+                                            train_iter=train_data)
+                        admitted = self._elastic_admit(
+                            kv_obj, checkpoint, elastic_data, elastic)
+                        if admitted is not None:
+                            # membership grew: swap in this rank's
+                            # re-sharded data mid-epoch, positioned at
+                            # the batch we just finished
+                            train_data = admitted
+                            _skip_batches(train_data, nbatch + 1)
+                            train_iter = iter(train_data)
+                            checkpoint.attach(self, train_data)
+                except DeadRankError as dead:
+                    if checkpoint is not None:
+                        checkpoint.step_abandoned()
+                    train_data, epoch, resume_nbatch = \
+                        self._elastic_recover(dead, kv_obj, checkpoint,
+                                              elastic_data, train_data)
+                    rolled_back = True
+                    break
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -233,6 +293,9 @@ class BaseModule:
                     for callback in _as_list(batch_end_callback):
                         callback(batch_end_params)
                 nbatch += 1
+
+            if rolled_back:
+                continue  # re-enter the (possibly rewound) epoch
 
             # one epoch of training is finished
             for name, val in eval_metric.get_name_value():
@@ -256,9 +319,154 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
 
             train_data.reset()
+            epoch += 1
         if checkpoint is not None:
             # land queued async snapshots before the process can exit
             checkpoint.flush()
+
+    # ------------------------------------------------------------------
+    # Elastic fault tolerance (ISSUE 8): rollback-resume + re-admission
+    # ------------------------------------------------------------------
+    def _elastic_recover(self, dead, kv, checkpoint, elastic_data,
+                         train_data):
+        """Resume-in-place after a DeadRankError verdict.
+
+        Survivors (1) agree on the shrunk membership epoch, (2)
+        re-scatter the last committed checkpoint's weights onto the
+        surviving parameter-server shards (``DistKVStore.remesh``), (3)
+        roll their own params/optimizer/RNG back to that snapshot, (4)
+        rebuild this rank's data shard for the new membership and
+        position it at the checkpointed batch.  Returns ``(train_data,
+        epoch, resume_nbatch)`` for fit to continue from.  Without a
+        checkpoint there is nothing consistent to roll back to — the
+        verdict propagates."""
+        from .. import profiler as _prof_mod
+        from ..base import MXNetError as _MXE
+
+        _prof_mod.inc_counter("elastic.dead_rank_verdicts")
+        if checkpoint is None:
+            raise _MXE(
+                "elastic recovery needs a CheckpointManager (pass "
+                "checkpoint=/set MXNET_CKPT_DIR with a save cadence): "
+                f"cannot roll back after {dead}") from dead
+        self.logger.warning("[elastic] %s — re-meshing and rolling back "
+                            "to the last committed checkpoint", dead)
+        t0 = time.time()
+        with checkpoint.rollback():
+            membership = getattr(kv, "membership", None)
+            rec = None
+            if membership is not None:
+                rec = membership.remesh(
+                    dead.dead_ranks,
+                    is_alive=lambda r: not kv.dead_ranks(ranks=[r]))
+            state = checkpoint.load_latest()
+            if state is None:
+                raise _MXE(
+                    "elastic recovery found no committed checkpoint to "
+                    "roll back to (did the first save cadence fire?)"
+                ) from dead
+            if membership is not None:
+                # kv keys are param indices (model._initialize_kvstore)
+                names = getattr(self, "_param_names",
+                                list(state["arg_params"]))
+                restored = {i: np.asarray(state["arg_params"][n])
+                            for i, n in enumerate(names)}
+                kv.remesh(rec, restored_params=restored)
+            # module-side rollback: params, optimizer state, RNG, step
+            self.set_params(state["arg_params"], state["aux_params"])
+            checkpoint.restore_training_state(self, state, train_iter=None)
+            opt = getattr(self, "_optimizer", None)
+            if opt is not None:
+                # restore_training_state only ever RAISES num_update
+                # (max with the live value, the forward-resume case);
+                # a rollback must REWIND it or every lr_scheduler step
+                # replays at post-death learning rates forever
+                nu = (state.get("optimizer") or {}).get("num_update")
+                if nu is not None:
+                    opt.num_update = int(nu)
+            if membership is not None:
+                if getattr(self, "_update_on_kvstore", False) \
+                        and opt is not None:
+                    # the shard reset cleared the server-side updater;
+                    # re-install AFTER the rollback so the shards get
+                    # the rewound optimizer, not the pre-death one
+                    kv.set_optimizer(opt)
+                if getattr(self, "_auto_rescale", False) \
+                        and opt is not None \
+                        and "dist" in kv.type and "_sync" in kv.type:
+                    # the 1/global-batch default must track the new
+                    # world size (a user-pinned rescale is never
+                    # touched); same dist_sync derivation as
+                    # init_optimizer — mesh-plan runs (batch_scale)
+                    # re-mesh through Module.remesh, not this path
+                    local_batch = self._data_shapes[0][1][0]
+                    opt.rescale_grad = 1.0 / (local_batch * kv.num_workers)
+            # data: re-shard for the new membership, positioned at the
+            # checkpointed batch (reset-and-skip keeps batch indices
+            # membership-invariant)
+            if elastic_data is not None and rec is not None:
+                train_data = elastic_data(list(rec["active"]))
+                checkpoint.attach(self, train_data)
+            _skip_batches(train_data, state["nbatch"] + 1)
+        _prof_mod.observe("elastic.recover_ms",
+                          (time.time() - t0) * 1e3)
+        self.logger.warning(
+            "[elastic] resumed at epoch %d batch %d (step %d) after "
+            "%.2fs", state["epoch"], state["nbatch"] + 1, state["step"],
+            time.time() - t0)
+        return train_data, int(state["epoch"]), int(state["nbatch"])
+
+    def _elastic_admit(self, kv, checkpoint, elastic_data, elastic):
+        """Checkpoint-boundary re-admission (scale back up).
+
+        Runs on EVERY active rank right after a cadence save so the
+        epoch flip is collective: the lowest active rank scans join
+        requests and commits the admitting epoch; an elastic barrier
+        aligns everyone; then every rank reads the ledger and, if the
+        epoch advanced, attaches to it (quorum grows, round clocks
+        restart) and re-shards its data.  Returns the new DataIter for
+        this rank (caller positions it), or None."""
+        if not elastic or kv is None or checkpoint is None:
+            return None
+        membership = getattr(kv, "membership", None)
+        if membership is None:
+            return None
+        every = checkpoint.every_n_steps
+        if not every or checkpoint._step % every != 0:
+            return None  # not a boundary — every rank agrees (cadence
+            #               and step counters are deterministic)
+        if kv.rank == min(kv.active_ranks):
+            from ..elastic import dead_rank_timeout
+
+            joins = membership.pending_joins(
+                max_age=dead_rank_timeout())
+            if joins:
+                # only admit against a committed checkpoint of THIS
+                # step: the joiner restores from it, and both sides
+                # must resume from identical state
+                checkpoint.flush()
+                from ..checkpoint import list_checkpoints
+                committed = [i for i in list_checkpoints(checkpoint.dir)
+                             if i.committed]
+                if committed and committed[-1].step == checkpoint._step:
+                    try:
+                        membership.admit(joins)
+                    except MXNetError as exc:
+                        # lost an epoch-commit race (e.g. a concurrent
+                        # scale-down consensus) — the winner's record
+                        # is attached below; re-admit next boundary
+                        self.logger.warning("[elastic] %s", exc)
+        kv._elastic_barrier()
+        rec = membership.read()
+        if rec is None or rec["epoch"] <= kv.epoch:
+            return None
+        kv.remesh(rec)  # scale-up: weights stay live on the shards
+        self.logger.warning("[elastic] scaled up to active=%s at "
+                            "membership epoch %d", rec["active"],
+                            rec["epoch"])
+        if elastic_data is not None:
+            return elastic_data(list(rec["active"]))
+        return None
 
     @contextmanager
     def _adopted_prologue(self, data_iter):
@@ -391,3 +599,21 @@ def _as_list(obj):
     if isinstance(obj, (list, tuple)):
         return obj
     return [obj]
+
+
+def _skip_batches(data_iter, n):
+    """Position a fresh epoch of ``data_iter`` AFTER its first ``n``
+    batches — the membership-invariant way to land on a checkpointed
+    position when the local shard layout may differ from the saving
+    run's (elastic re-shard): batch INDICES line up across any world
+    size as long as the global batch layout is fixed, while a raw
+    cursor snapshot would not."""
+    data_iter.reset()
+    if n <= 0:
+        return
+    it = iter(data_iter)
+    for _ in range(n):
+        try:
+            next(it)
+        except StopIteration:
+            break

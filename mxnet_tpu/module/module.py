@@ -557,12 +557,17 @@ class Module(BaseModule):
                 batch_size *= self._mesh_plan.batch_scale
             idx2name = {i: n for i, n in enumerate(self._param_names)}
             optimizer_params = dict(optimizer_params)
-            if "rescale_grad" not in optimizer_params:
+            # remember whether the 1/global-batch default was derived
+            # here: an elastic re-mesh must recompute it for the new
+            # world size, but must never touch a user-pinned value
+            self._auto_rescale = "rescale_grad" not in optimizer_params
+            if self._auto_rescale:
                 optimizer_params["rescale_grad"] = 1.0 / batch_size
             optimizer = opt.create(optimizer, sym=self.symbol,
                                    param_idx2name=idx2name, **optimizer_params)
         else:
             assert isinstance(optimizer, opt.Optimizer)
+            self._auto_rescale = False
 
         self._optimizer = optimizer
         self._kvstore = kvstore
@@ -594,6 +599,59 @@ class Module(BaseModule):
         assert self.binded, "call bind before set_mesh_plan"
         self._mesh_plan = plan
         self._apply_mesh_plan()
+
+    def remesh(self, plan):
+        """Rebuild this module's program on a NEW MeshPlan (dp' < dp
+        after losing devices, or dp' > dp after regaining them),
+        carrying the complete training state across the layout change.
+
+        The ZeRO-1 optimizer state is the interesting part: under the
+        old plan it lives as flat 'dp'-sharded slices.  It is gathered
+        to layout-independent param-shaped host values through the
+        PR-4 checkpoint path (``_optimizer_states_to_host``), the old
+        plan's programs and device state are dropped, and the first
+        step under the new plan re-scatters it into dp'-sharded slices
+        (``_place_state_tree`` via the pending-states hook) — exactly
+        the machinery a cross-layout checkpoint restore uses, so a
+        re-mesh is checkpoint-equivalent by construction.  The PRNG
+        base key and step counter travel too: a re-meshed run replays
+        the same dropout/augmentation streams.
+
+        Not for ``update_on_kvstore`` modules — their re-mesh is the
+        kvstore's (``DistKVStore.remesh``)."""
+        assert self.binded and self.params_initialized
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "Module.remesh re-shards the in-program (fused/ZeRO) "
+                "state; an update_on_kvstore module re-meshes through "
+                "DistKVStore.remesh instead")
+        opt_payload = None
+        if self.optimizer_initialized:
+            opt_payload = self._optimizer_states_to_host(lazy=False)
+        arg_params, aux_params = self.get_params()
+        args = {k: v.asnumpy() for k, v in arg_params.items()}
+        auxs = {k: v.asnumpy() for k, v in aux_params.items()}
+        # drop every old-layout artifact: programs, device state, caches
+        self._mesh_plan = plan
+        self._fused_step = None
+        self._apply_grads = None
+        self._fused_state = None
+        self._fused_t = None
+        self._fused_key = None
+        self._fused_warm = False
+        self._fused_step_by_prologue = _PrologueCache()
+        self._lr_cache = {}
+        self._zero = False
+        self._zero_meta = None
+        self._apply_mesh_plan()
+        self.set_params(args, auxs)
+        if opt_payload is not None:
+            # host payload → pending states; the next _ensure_fused_built
+            # re-scatters into the NEW dp' layout
+            self._install_optimizer_states(opt_payload)
+        if self._kvstore is not None:
+            self._kvstore.mesh_plan = plan
+        _prof.inc_counter("elastic.module_remesh")
 
     def set_input_prologue(self, fn):
         """Install a device-side input prologue: a jax-traceable
@@ -1403,6 +1461,22 @@ class Module(BaseModule):
                 quiesce()  # the comm thread may be mid-update
             updater = getattr(kv, "_updater", None)
             if updater is None:
+                # server-side updates: the state lives on the shards.
+                # A provably STATELESS optimizer (init_state_arrays is
+                # None — plain SGD, SGLD) has nothing to lose, so the
+                # snapshot degrades to num_update only (the elastic
+                # drill's configuration); anything stateful must refuse
+                # rather than silently drop momentum on restore
+                import jax.numpy as jnp
+
+                try:
+                    stateless = self._optimizer.init_state_arrays(
+                        jnp.zeros((1,), jnp.float32)) is None
+                except Exception:  # noqa: BLE001 — exotic optimizer
+                    stateless = False
+                if stateless:
+                    return {"kind": "updater", "blob": b"",
+                            "num_update": num_update}
                 raise MXNetError(
                     "cannot snapshot optimizer state: the kvstore keeps "
                     "it server-side (MXNET_KVSTORE_SYNC_ON_SERVER)")

@@ -60,7 +60,35 @@ _I64 = struct.Struct("!q")
 
 # ops
 (_INIT, _PUSH, _PULL, _SET_OPT, _NUM_APPLIED, _STOP, _PUSH_SYNC,
- _PUSH_MULTI, _PULL_MULTI) = range(1, 10)
+ _PUSH_MULTI, _PULL_MULTI, _REMESH) = range(1, 11)
+
+# errno values classified as TRANSIENT: a reconnect may heal them
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(__import__("errno"), n) for n in
+    ("ECONNRESET", "EPIPE", "ECONNABORTED", "ECONNREFUSED", "ETIMEDOUT")
+    if hasattr(__import__("errno"), n))
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Socket failures a bounded reconnect may heal (ECONNRESET/EPIPE
+    mid-frame, a shard restarting) — vs. protocol errors and response-
+    pipeline corruption, which must stay fatal."""
+    if isinstance(exc, ConnectionError):  # reset/refused/aborted/pipe
+        return True
+    if isinstance(exc, socket.timeout):
+        return False  # 630s of silence is a hang, not a blip
+    if isinstance(exc, OSError):
+        return exc.errno in _TRANSIENT_ERRNOS
+    return False
+
+
+def reconnect_budget() -> int:
+    """MXNET_KVSTORE_RECONNECTS with loud validation (0 disables);
+    default resolves through the config catalog — no duplicated
+    literal."""
+    from .elastic import _validated_env
+
+    return int(_validated_env("MXNET_KVSTORE_RECONNECTS", minimum=0))
 
 
 def bigarray_bound() -> int:
@@ -185,15 +213,19 @@ def _body_init(key, value) -> bytes:
     return bytes([_INIT]) + _pack_key(key) + _pack_tensor(np.asarray(value))
 
 
-def _body_push(key, grad, sync: bool, worker: int = 0) -> bytes:
+def _body_push(key, grad, sync: bool, worker: int = 0,
+               epoch: int = 0) -> bytes:
     # the worker id rides every push frame so the sync server can tell
-    # "all workers pushed" from "one worker pushed num_workers times"
+    # "all workers pushed" from "one worker pushed num_workers times";
+    # the membership epoch fences frames from dead/returning ranks
     return (bytes([_PUSH_SYNC if sync else _PUSH]) + _pack_key(key)
-            + _U32.pack(worker) + _pack_tensor(np.asarray(grad)))
+            + _U32.pack(worker) + _U32.pack(epoch)
+            + _pack_tensor(np.asarray(grad)))
 
 
-def _body_pull(key, min_round: int) -> bytes:
-    return bytes([_PULL]) + _pack_key(key) + _U64.pack(min_round)
+def _body_pull(key, min_round: int, epoch: int = 0) -> bytes:
+    return (bytes([_PULL]) + _pack_key(key) + _U64.pack(min_round)
+            + _U32.pack(epoch))
 
 
 # ---------------------------------------------------------------------------
@@ -211,7 +243,8 @@ class ParameterServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  secret: bytes = b"", num_workers: int = 1,
-                 sync: bool = False, watchdog_deadline: Optional[float] = None):
+                 sync: bool = False, watchdog_deadline: Optional[float] = None,
+                 sync_wait_timeout: float = 600.0):
         self._store: Dict[Any, np.ndarray] = {}
         self._applied: Dict[Any, int] = {}   # pushes applied (version)
         self._round: Dict[Any, int] = {}     # completed update rounds
@@ -227,6 +260,12 @@ class ParameterServer:
         self._secret = secret
         self._num_workers = num_workers
         self._sync = sync
+        self._sync_wait = float(sync_wait_timeout)
+        # membership epoch: frames from another epoch are rejected, and
+        # an epoch advance wakes + fails every round-blocked waiter —
+        # the fence that keeps a dead/returning rank's stale traffic
+        # out of the re-meshed run (see mxnet_tpu.elastic)
+        self._epoch = 0
         self._cond = threading.Condition()
         from .base import get_env
 
@@ -282,8 +321,11 @@ class ParameterServer:
                 key, off = _unpack_key(buf, off)
                 (worker,) = _U32.unpack_from(buf, off)
                 off += 4
+                (epoch,) = _U32.unpack_from(buf, off)
+                off += 4
                 grad, _ = _unpack_tensor(buf, off)
                 with self._cond:
+                    self._check_epoch(epoch)
                     self._push_one(key, worker, grad,
                                    sync=(op != _PUSH or self._sync))
                 return b"\x00"
@@ -295,21 +337,29 @@ class ParameterServer:
                 off += 1
                 (worker,) = _U32.unpack_from(buf, off)
                 off += 4
+                (epoch,) = _U32.unpack_from(buf, off)
+                off += 4
                 (count,) = struct.unpack_from("!H", buf, off)
                 off += 2
                 for _ in range(count):
                     key, off = _unpack_key(buf, off)
                     grad, off = _unpack_tensor(buf, off)
                     with self._cond:
+                        self._check_epoch(epoch)
                         self._push_one(key, worker, grad,
                                        sync=(sync or self._sync))
                 return b"\x00"
             if op == _PULL:
                 key, off = _unpack_key(buf, off)
                 (min_round,) = _U64.unpack_from(buf, off)
+                off += 8
+                (epoch,) = _U32.unpack_from(buf, off)
                 with self._cond:
+                    self._check_epoch(epoch)
                     return b"\x00" + self._pull_one(key, min_round)
             if op == _PULL_MULTI:
+                (epoch,) = _U32.unpack_from(buf, off)
+                off += 4
                 (count,) = struct.unpack_from("!H", buf, off)
                 off += 2
                 parts = [b"\x00"]
@@ -318,8 +368,30 @@ class ParameterServer:
                     (min_round,) = _U64.unpack_from(buf, off)
                     off += 8
                     with self._cond:
+                        self._check_epoch(epoch)
                         parts.append(self._pull_one(key, min_round))
                 return b"".join(parts)
+            if op == _REMESH:
+                (blen,) = _U32.unpack_from(buf, off)
+                off += 4
+                blob = bytes(buf[off:off + blen])
+                off += blen
+                mac = bytes(buf[off:off + 32])
+                if not self._secret:
+                    raise MXNetError(
+                        "server has no HMAC secret — remesh refused "
+                        "(membership changes must be authenticated)")
+                want = hmac.new(self._secret, blob, hashlib.sha256).digest()
+                if not hmac.compare_digest(mac, want):
+                    raise MXNetError("remesh frame failed HMAC verification")
+                import json as _json
+
+                spec = _json.loads(blob.decode())
+                with self._cond:
+                    self._remesh(int(spec["epoch"]),
+                                 int(spec["num_workers"]),
+                                 bool(spec.get("reset")))
+                return b"\x00"
             if op == _NUM_APPLIED:
                 key, _ = _unpack_key(buf, off)
                 with self._cond:
@@ -397,6 +469,46 @@ class ParameterServer:
                     arrived, missing)
                 _prof.inc_counter("watchdog.ps_round_timeouts")
 
+    def _check_epoch(self, epoch: int) -> None:
+        """Membership fence — caller holds the lock.  A frame from any
+        OTHER epoch is rejected: stale traffic from a dead rank's last
+        gasp, or a returning rank racing its admission."""
+        if epoch != self._epoch:
+            raise MXNetError(
+                f"stale membership epoch {epoch} (server at epoch "
+                f"{self._epoch}) — re-mesh before pushing/pulling")
+
+    def _remesh(self, epoch: int, num_workers: int, reset: bool) -> None:
+        """Install a new membership epoch — caller holds the lock.
+        Idempotent per epoch (every survivor may send it).  ``reset``
+        (scale-down rollback) clears weights, open rounds and the
+        updater so the survivors' re-scatter from the last committed
+        checkpoint starts from a blank, consistent shard; scale-up
+        keeps the store and only realigns epoch/quorum/round counters.
+        Every round-blocked waiter wakes and fails its (stale) wait."""
+        if epoch < self._epoch:
+            raise MXNetError(
+                f"remesh to epoch {epoch} refused: server already at "
+                f"epoch {self._epoch}")
+        if epoch == self._epoch:
+            return  # duplicate from a peer survivor — already applied
+        self._epoch = epoch
+        self._num_workers = num_workers
+        self._pending.clear()
+        self._contrib.clear()
+        self._arrivals.clear()
+        self._round_open_t.clear()
+        self._round_warned.clear()
+        # both directions realign the round clock to 0 so every
+        # member's pull gate counts from the same origin at this epoch
+        self._round = {k: 0 for k in self._round}
+        if reset:
+            self._store.clear()
+            self._applied.clear()
+            self._round.clear()
+            self._updater = None
+        self._cond.notify_all()
+
     def _push_one(self, key, worker: int, grad: np.ndarray, sync: bool):
         """Apply/merge ONE key's push — caller holds the lock (the
         shared body of _PUSH, _PUSH_SYNC and _PUSH_MULTI frames)."""
@@ -410,9 +522,15 @@ class ParameterServer:
         # to the NEXT round — queue it (block this worker's handler
         # thread until the open round completes) rather than letting it
         # complete the round early with a peer's gradient missing.
+        e0 = self._epoch
         ok = self._cond.wait_for(
-            lambda: worker not in self._contrib.get(key, ()),
-            timeout=600.0)
+            lambda: worker not in self._contrib.get(key, ())
+            or self._epoch != e0,
+            timeout=self._sync_wait)
+        if self._epoch != e0:
+            raise MXNetError(
+                f"push({key}): membership re-meshed to epoch "
+                f"{self._epoch} while queued — retry under the new epoch")
         if not ok:
             raise MXNetError(
                 f"duplicate push({key}) from worker {worker} timed out "
@@ -451,10 +569,20 @@ class ParameterServer:
         the ``round || tensor`` wire payload (no status byte)."""
         if key not in self._store:
             raise MXNetError(f"pull from uninitialized key {key}")
-        # BSP wait: block until the requested round completed
+        # BSP wait: block until the requested round completed (bounded:
+        # in elastic mode the kvstore passes a dead-rank-timeout-derived
+        # sync_wait_timeout so a dead peer surfaces as an error frame —
+        # the client converts it to a DeadRankError verdict — instead
+        # of a 600 s hang)
+        e0 = self._epoch
         ok = self._cond.wait_for(
-            lambda: self._round.get(key, 0) >= min_round,
-            timeout=600.0)
+            lambda: self._round.get(key, 0) >= min_round
+            or self._epoch != e0,
+            timeout=self._sync_wait)
+        if self._epoch != e0:
+            raise MXNetError(
+                f"pull({key}): membership re-meshed to epoch "
+                f"{self._epoch} while waiting for round {min_round}")
         if not ok:
             raise MXNetError(
                 f"pull({key}) timed out waiting for round "
@@ -511,6 +639,7 @@ class PSClient:
         # are N distinct workers (the pre-tracking behavior); pass an
         # explicit id to make retries/reconnects count as one worker.
         self._worker = next(_WORKER_IDS) if worker is None else worker
+        self.epoch = 0  # membership epoch stamped on push/pull frames
         # one mutex guards the ticket counters; _lock stays as a public-
         # ish alias for raw-frame tests that bypass the ticket pipeline
         self._mu = threading.Lock()
@@ -520,21 +649,91 @@ class PSClient:
         self._sent = 0    # tickets issued (== frames written)
         self._recvd = 0   # responses consumed
         self._dead: Optional[BaseException] = None
+        self._dead_transient = False
+        # bounded reconnect: a fresh socket generation; finishers of an
+        # older generation fail instead of reading the new pipe
+        self._gen = 0
+        self._reconnects_used = 0
+        self._reconnecting = False
+        self._reconnect_budget = reconnect_budget()
+        self._sock = self._connect(timeout)
+
+    def _connect(self, timeout: float) -> socket.socket:
         import time
 
         t0 = time.time()
         while True:
             try:
-                self._sock = socket.create_connection(self._addr, timeout=10)
+                sock = socket.create_connection(self._addr, timeout=10)
                 # widen after connect: sync pulls legitimately block for
                 # a whole round; keep a ceiling so a dead server surfaces
-                self._sock.settimeout(630.0)
-                break
+                sock.settimeout(630.0)
+                return sock
             except OSError:
                 if time.time() - t0 > timeout:
                     raise MXNetError(
                         f"cannot reach parameter server at {self._addr}")
                 time.sleep(0.2)
+
+    def _reconnect_locked(self) -> bool:
+        """Attempt ONE reconnect (caller holds the mutex and has
+        classified the failure as transient).  Exponential backoff +
+        jitter; bounded by MXNET_KVSTORE_RECONNECTS — only when the
+        budget is exhausted does the connection stay dead (and the
+        comm scheduler's launch failure poison the scheduler).
+        Outstanding tickets of the old socket are unrecoverable: their
+        finishers fail on the generation check; the counters restart
+        for the new pipe."""
+        import random
+
+        # single-flight: the backoff wait below releases the mutex, so
+        # a second _begin could race in here — it must wait for the
+        # in-flight attempt's outcome instead of double-reconnecting
+        while self._reconnecting:
+            self._can_send.wait(timeout=1.0)
+            if self._dead is None:
+                return True  # the other thread healed the connection
+        if self._dead is None:
+            return True
+        if self._reconnects_used >= self._reconnect_budget:
+            return False
+        self._reconnecting = True
+        try:
+            return self._reconnect_attempt_locked(random)
+        finally:
+            self._reconnecting = False
+            self._can_send.notify_all()
+            self._can_recv.notify_all()
+
+    def _reconnect_attempt_locked(self, random) -> bool:
+        self._reconnects_used += 1
+        base = min(2.0, 0.05 * (2 ** (self._reconnects_used - 1)))
+        delay = base + random.uniform(0.0, base)
+        logging.warning(
+            "[ps] connection to %s failed (%s); reconnect %d/%d in %.2fs",
+            self._addr, self._dead, self._reconnects_used,
+            self._reconnect_budget, delay)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # back off on the CONDITION, not time.sleep: waiting releases
+        # the client mutex so outstanding finishers can fail fast
+        # instead of queueing behind the sleeping reconnector
+        self._can_send.wait(timeout=delay)
+        try:
+            self._sock = self._connect(timeout=10.0)
+        except MXNetError:
+            return False
+        self._gen += 1
+        self._sent = 0
+        self._recvd = 0
+        self._dead = None
+        self._dead_transient = False
+        _prof.inc_counter("ps.reconnects")
+        self._can_send.notify_all()
+        self._can_recv.notify_all()
+        return True
 
     def _begin(self, body: bytes):
         """Send now, collect later.  Ticketed window: the frame goes out
@@ -547,32 +746,64 @@ class PSClient:
         eventually be called (an abandoned one stalls all later
         tickets); a socket-level failure poisons the connection for all
         outstanding tickets."""
+        from .chaos import get_chaos
         from .comm import inflight_window
 
         limit = inflight_window()
+        chaos = get_chaos()
+        chaos_rank = self._worker if self._worker < (1 << 31) else None
+        framed = _U32.pack(len(body)) + body
         with self._can_send:
-            while self._sent - self._recvd >= limit and self._dead is None:
-                if not self._can_send.wait(timeout=630.0):
+            while True:
+                if self._dead is not None:
+                    # transient failure (ECONNRESET/EPIPE mid-frame, a
+                    # restarting shard): bounded reconnect with backoff
+                    # + jitter before giving up — only an exhausted
+                    # budget leaves the connection dead for callers
+                    # (and lets the comm scheduler poison itself)
+                    if not (self._dead_transient
+                            and self._reconnect_locked()):
+                        raise MXNetError(
+                            f"parameter server connection {self._addr} "
+                            f"is dead: {self._dead}") from self._dead
+                    continue  # fresh socket — re-evaluate the window
+                if self._sent - self._recvd < limit:
+                    pass
+                elif not self._can_send.wait(timeout=630.0):
                     raise MXNetError(
                         f"parameter server {self._addr}: in-flight window "
                         "stuck (an earlier finisher was never collected?)")
-            if self._dead is not None:
-                raise MXNetError(
-                    f"parameter server connection {self._addr} is dead: "
-                    f"{self._dead}") from self._dead
-            ticket = self._sent
-            try:
-                _send_frame(self._sock, body)
-            except BaseException as e:
-                self._dead = e
-                self._can_send.notify_all()
-                self._can_recv.notify_all()
-                raise
-            self._sent += 1
+                else:
+                    continue
+                ticket = self._sent
+                gen = self._gen
+                try:
+                    if chaos.armed and chaos.torn_send(
+                            self._sock, framed, rank=chaos_rank):
+                        raise ConnectionResetError(
+                            "chaos: frame torn mid-send")
+                    self._sock.sendall(framed)
+                except BaseException as e:
+                    # a failed sendall leaves at most a PREFIX of the
+                    # frame on the wire; the server discards torn frames
+                    # with the connection, so a resend after reconnect
+                    # is exactly-once safe.  (Failures after the full
+                    # frame landed surface in finish() and are NOT
+                    # resent.)
+                    self._dead = e
+                    self._dead_transient = _is_transient(e)
+                    self._can_send.notify_all()
+                    self._can_recv.notify_all()
+                    if self._dead_transient:
+                        continue  # retry via the reconnect branch
+                    raise
+                self._sent += 1
+                break
 
         def finish() -> memoryview:
             with self._can_recv:
-                while self._recvd != ticket and self._dead is None:
+                while self._recvd != ticket and self._dead is None \
+                        and self._gen == gen:
                     if not self._can_recv.wait(timeout=630.0):
                         # an earlier ticket's finisher was abandoned:
                         # its response will never be read, so the whole
@@ -588,25 +819,42 @@ class PSClient:
                         raise MXNetError(
                             f"parameter server {self._addr}: response "
                             f"pipeline stuck before ticket {ticket}")
+                if self._gen != gen:
+                    raise MXNetError(
+                        f"parameter server {self._addr}: connection was "
+                        "reset while this request was in flight (its "
+                        "response is unrecoverable — retry the op)")
                 if self._dead is not None:
                     raise MXNetError(
                         f"parameter server connection {self._addr} is "
                         f"dead: {self._dead}") from self._dead
+                sock = self._sock  # this generation's pipe
             # the socket read runs OUTSIDE the mutex so later tickets
             # can keep SENDING (full-duplex) while we wait; only this
             # ticket may read — successors block until _recvd advances
+            # NOTE: a transient failure HERE (response lost after the
+            # frame was fully sent) is deliberately NOT retried and
+            # poisons the connection: the server may or may not have
+            # applied the frame, and resending a maybe-applied gradient
+            # would double-count it.  Fail-stop instead — in an elastic
+            # run the peers convict this process and re-mesh, and it
+            # returns as a joiner (exactly-once beats availability
+            # here).  The reconnect budget covers SEND-side failures,
+            # where a torn frame provably died with its connection.
             exc = None
             resp = None
             try:
-                resp = _recv_frame(self._sock)
+                resp = _recv_frame(sock)
             except BaseException as e:
                 exc = e
             with self._can_recv:
-                self._recvd += 1
-                if exc is not None:
-                    self._dead = exc
-                self._can_recv.notify_all()
-                self._can_send.notify_all()
+                if self._gen == gen:
+                    self._recvd += 1
+                    if exc is not None:
+                        self._dead = exc
+                        self._dead_transient = _is_transient(exc)
+                    self._can_recv.notify_all()
+                    self._can_send.notify_all()
             if exc is not None:
                 raise exc
             if resp[0] != 0:
@@ -628,19 +876,19 @@ class PSClient:
         with _prof.scope("ps.push", "comm",
                          args={"key": str(key), "bytes": int(grad.nbytes)}):
             self._call(_body_push(key, grad, sync=False,
-                                  worker=self._worker))
+                                  worker=self._worker, epoch=self.epoch))
 
     def push_sync(self, key, grad: np.ndarray):
         grad = np.asarray(grad)
         with _prof.scope("ps.push_sync", "comm",
                          args={"key": str(key), "bytes": int(grad.nbytes)}):
             self._call(_body_push(key, grad, sync=True,
-                                  worker=self._worker))
+                                  worker=self._worker, epoch=self.epoch))
 
     def pull(self, key, min_round: int = 0) -> np.ndarray:
         with _prof.scope("ps.pull", "comm",
                          args={"key": str(key), "min_round": min_round}):
-            resp = self._call(_body_pull(key, min_round))
+            resp = self._call(_body_pull(key, min_round, epoch=self.epoch))
         arr, _ = _unpack_tensor(resp, 1 + 8)
         return np.array(arr)  # own the buffer (resp view dies here)
 
@@ -683,6 +931,30 @@ class ShardedPSClient:
         # key → total flat size, recorded at init: num_applied and
         # shape-less pulls must plan the same split init/push used
         self._sizes: Dict[Any, int] = {}
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Stamp every subsequent push/pull frame with this membership
+        epoch (see ParameterServer._check_epoch)."""
+        self.epoch = int(epoch)
+        for cl in self.clients:
+            cl.epoch = int(epoch)
+
+    def remesh(self, epoch: int, num_workers: int, reset: bool = False):
+        """Advance every shard to membership ``epoch`` with the new
+        sync quorum (idempotent per epoch; HMAC-authenticated).
+        ``reset=True`` additionally clears the shards for the
+        re-scatter from the last committed checkpoint."""
+        import json as _json
+
+        blob = _json.dumps({"epoch": int(epoch),
+                            "num_workers": int(num_workers),
+                            "reset": bool(reset)}).encode()
+        self._fan_out([
+            (cl, bytes([_REMESH]) + _U32.pack(len(blob)) + blob
+             + hmac.new(cl._secret, blob, hashlib.sha256).digest(), None)
+            for cl in self.clients])
+        self.set_epoch(epoch)
 
     @property
     def num_servers(self) -> int:
@@ -759,7 +1031,8 @@ class ShardedPSClient:
                                "shards": len(plan)}):
             self._fan_out([
                 (cl, _body_push(wk, flat[a:b] if (a, b) != (0, grad.size)
-                                else grad, sync, worker=cl._worker), None)
+                                else grad, sync, worker=cl._worker,
+                                epoch=cl.epoch), None)
                 for cl, wk, a, b in plan])
 
     def push(self, key, grad: np.ndarray):
@@ -796,6 +1069,7 @@ class ShardedPSClient:
                         "this unreachable)")
                 body = bytearray([_PUSH_MULTI, 1 if sync else 0])
                 body += _U32.pack(cl._worker)
+                body += _U32.pack(cl.epoch)
                 body += struct.pack("!H", len(items))
                 for wk, arr in items:
                     body += _pack_key(wk) + _pack_tensor(arr)
@@ -847,6 +1121,7 @@ class ShardedPSClient:
                     f"pull_multi: {len(items)} keys for one shard "
                     "exceeds the u16 frame limit — split the request")
             body = bytearray([_PULL_MULTI])
+            body += _U32.pack(cl.epoch)
             body += struct.pack("!H", len(items))
             for wk, mr, _idx, _a, _b in items:
                 body += _pack_key(wk) + _U64.pack(mr)
@@ -884,7 +1159,7 @@ class ShardedPSClient:
                                "shards": len(plan),
                                "min_round": min_round}):
             for resp, (a, b) in self._fan_out([
-                    (cl, _body_pull(wk, min_round), (a, b))
+                    (cl, _body_pull(wk, min_round, epoch=cl.epoch), (a, b))
                     for cl, wk, a, b in plan]):
                 arr, _ = _unpack_tensor(resp, 1 + 8)
                 out[a:b] = arr.reshape(-1)

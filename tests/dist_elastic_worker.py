@@ -1,0 +1,141 @@
+"""Elastic 2→1→2 chaos-drill worker.
+
+Launched by ``tools/chaos_drill.py`` (and the slow test in
+tests/test_dist.py) with ``MXNET_ELASTIC=1``: each rank trains
+``Module.fit`` with ``kvstore='dist_sync'`` (elastic mode forces the
+reconnectable server-sync PS transport) on its membership-dependent
+shard of a FIXED global batch layout, checkpointing synchronously
+every 4 steps.  The drill kills rank 1 with ``MXNET_CHAOS_KILL_STEP``
+(SIGKILL — no goodbye): rank 0's next sync round times out, the stale
+heartbeat turns that into a DeadRankError verdict, and fit re-meshes
+to dp'=1, re-scatters the last committed checkpoint onto the surviving
+shard, rolls back, and keeps training.  The drill then respawns rank 1
+with ``MXNET_ELASTIC_JOIN=1``; it files a join request, is admitted at
+rank 0's next checkpoint boundary, restores from that checkpoint, and
+both ranks finish together.  Because the global batch sequence is
+membership-invariant and rollback replays from committed state, the
+final weights must converge to an uninterrupted single-process run on
+the union data (asserted by the drill within tolerance).
+
+Prints one machine-readable line::
+
+    ELASTIC_WORKER rank=<r> steps=<n> max_gap_s=<s> remesh=<n> \
+        verdicts=<n> joins=<n> reconnects=<n>
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+GLOBAL_BATCH = 8
+N_SAMPLES = 64
+EPOCHS = 3
+CLASSES = 4
+FEATURES = 16
+
+
+def build_sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=24, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def make_data():
+    rng = np.random.RandomState(5)
+    X = rng.randn(N_SAMPLES, FEATURES).astype(np.float32)
+    y = rng.randint(0, CLASSES, N_SAMPLES).astype(np.float32)
+    return X, y
+
+
+def elastic_iter(X, y, rank, active):
+    """This rank's shard of the FIXED global batch layout under the
+    given membership: global batch g is split contiguously among the
+    sorted active ranks, so batch INDICES mean the same thing at any
+    world size — the invariant elastic repositioning relies on."""
+    active = sorted(active)
+    B = GLOBAL_BATCH // len(active)
+    pos = active.index(rank)
+    idx = []
+    for g in range(N_SAMPLES // GLOBAL_BATCH):
+        start = g * GLOBAL_BATCH + pos * B
+        idx.extend(range(start, start + B))
+    return mx.io.NDArrayIter(X[idx], y[idx], batch_size=B, shuffle=False,
+                             label_name="softmax_label")
+
+
+def train_reference():
+    """Uninterrupted single-process run on the union data — the
+    convergence target of the drill."""
+    X, y = make_data()
+    mx.random.seed(7)
+    np.random.seed(7)
+    it = mx.io.NDArrayIter(X, y, batch_size=GLOBAL_BATCH, shuffle=False,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(build_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=EPOCHS, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05,
+                              "rescale_grad": 1.0 / GLOBAL_BATCH},
+            kvstore=None,
+            initializer=mx.initializer.Xavier(rnd_type="gaussian"),
+            eval_metric="acc")
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}
+
+
+def main():
+    import logging
+
+    logging.basicConfig(level=logging.INFO)
+    ckpt_dir, out_prefix = sys.argv[1], sys.argv[2]
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+    X, y = make_data()
+    it = elastic_iter(X, y, rank, kv.active_ranks)
+
+    mx.random.seed(7)
+    np.random.seed(7)
+    mod = mx.mod.Module(build_sym(), context=mx.cpu())
+    cadence = int(os.environ.get("ELASTIC_CKPT_EVERY", "4"))
+    mgr = mx.CheckpointManager(ckpt_dir, every_n_steps=cadence,
+                               async_save=False, keep=20, kvstore=kv)
+    step_times = []
+    mod.fit(it, num_epoch=EPOCHS, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05,
+                              "rescale_grad": 1.0 / GLOBAL_BATCH},
+            kvstore=kv,
+            initializer=mx.initializer.Xavier(rnd_type="gaussian"),
+            eval_metric="acc", checkpoint=mgr, resume="auto",
+            elastic_data=lambda active: elastic_iter(X, y, rank, active),
+            batch_end_callback=lambda p: step_times.append(time.time()))
+    mgr.close()
+    args_, _ = mod.get_params()
+    np.savez(out_prefix + f".rank{rank}",
+             **{k: v.asnumpy() for k, v in args_.items()})
+    kv.barrier()
+
+    from mxnet_tpu import profiler as prof
+
+    counters = prof.metrics_summary().get("counters", {})
+
+    def count(name):
+        return int(counters.get(name, 0) or 0)
+
+    gaps = [b - a for a, b in zip(step_times, step_times[1:])]
+    print(f"ELASTIC_WORKER rank={rank} steps={len(step_times)} "
+          f"max_gap_s={max(gaps) if gaps else 0.0:.2f} "
+          f"remesh={count('elastic.remesh')} "
+          f"verdicts={count('elastic.dead_rank_verdicts')} "
+          f"joins={count('elastic.joins')} "
+          f"reconnects={count('ps.reconnects')}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

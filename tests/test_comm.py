@@ -376,9 +376,18 @@ def test_get_num_dead_node_unified_default():
 
     from mxnet_tpu.kvstore import DistKVStore, KVStore
 
+    # the staleness threshold is unified in the config catalog
+    # (MXNET_DEAD_RANK_TIMEOUT): every consumer defaults to None and
+    # resolves through it — no scattered literals
     for cls in (KVStore, DistKVStore):
         sig = inspect.signature(cls.get_num_dead_node)
-        assert sig.parameters["timeout"].default == 60, cls
+        assert sig.parameters["timeout"].default is None, cls
+    assert inspect.signature(
+        DistKVStore.dead_ranks).parameters["timeout"].default is None
+    from mxnet_tpu import config
+
+    assert config.describe("MXNET_DEAD_RANK_TIMEOUT").default == 60.0
+    assert config.describe("MXNET_HEARTBEAT_INTERVAL").default == 1.0
     assert mx.kv.create("local").get_num_dead_node() == 0
 
 

@@ -380,6 +380,38 @@ def test_launch_module_fit_dist_async():
     assert digests[0] == digests[1], f"worker weight digests differ: {digests}"
 
 
+@pytest.mark.slow
+def test_elastic_chaos_drill_2_1_2(tmp_path):
+    """ISSUE 8 acceptance: the 2→1→2 elastic drill.  Rank 1 SIGKILLed
+    mid-epoch; rank 0 must reach the DeadRankError verdict within the
+    dead-rank timeout, re-mesh to dp'=1, re-scatter the last committed
+    checkpoint onto the surviving shard, resume with no dropped or
+    duplicated samples, re-admit the restarted rank at a checkpoint
+    boundary, and converge to an uninterrupted run — zero operator
+    actions (tier-1 runs the single-process smoke instead:
+    tests/test_elastic.py::test_dead_rank_rollback_resume_bitexact)."""
+    import json
+
+    out = str(tmp_path / "drill")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_drill.py"),
+         "--out", out, "--kill-step", "10"],
+        capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    verdict = json.loads(r.stdout.strip().splitlines()[-1])
+    assert verdict["converged"], verdict
+    assert verdict["rebuilds"] >= 1, verdict       # a re-mesh happened
+    assert verdict["rejoined"], verdict            # scale back up 1→2
+    # rollback replay is bounded by the checkpoint cadence (plus the
+    # admission re-shard of the joiner counting from its restore point)
+    assert 0 <= verdict["steps_lost"] <= 2 * verdict["ckpt_every_n_steps"], \
+        verdict
+    # no barrier/sync hung past its deadline: downtime (the largest
+    # step-to-step gap on the survivor) stays within detection +
+    # recovery bounds
+    assert verdict["downtime_s"] < 3 * verdict["dead_timeout_s"], verdict
+
+
 def test_ckpt_kill_and_resume(tmp_path):
     """Acceptance: kill -9 both workers of a 2-proc dist_sync fit
     EXACTLY between the checkpoint barrier and rank 0's COMMIT, then

@@ -1,0 +1,598 @@
+"""Elastic fault-tolerant training (ISSUE 8): failure verdicts,
+membership epochs, chaos injection, rollback-resume, and the ZeRO
+re-scatter across MeshPlans — everything single-process so tier-1
+stays fast; the real 2→1→2 process drill lives in test_dist.py
+(slow) / tools/chaos_drill.py."""
+
+import os
+import signal
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.chaos import Chaos, get_chaos, reset_chaos
+from mxnet_tpu.elastic import DeadRankError, Membership
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in ("MXNET_ELASTIC", "MXNET_ELASTIC_JOIN",
+                "MXNET_HEARTBEAT_INTERVAL", "MXNET_DEAD_RANK_TIMEOUT",
+                "MXNET_CHAOS_KILL_STEP", "MXNET_CHAOS_DEAD_RANK_STEP",
+                "MXNET_CHAOS_DEAD_RANKS", "MXNET_CHAOS_HEARTBEAT_STALL",
+                "MXNET_CHAOS_TORN_SOCKET", "MXNET_CHAOS_SLOW_RANK",
+                "MXNET_CHAOS_RANK", "MXNET_CKPT_DIR"):
+        monkeypatch.delenv(var, raising=False)
+    reset_chaos()
+    yield
+    reset_chaos()
+
+
+# ---------------------------------------------------------------------------
+# satellite: unified liveness config with loud validation
+# ---------------------------------------------------------------------------
+
+def test_liveness_env_validation(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_HEARTBEAT_INTERVAL", "banana")
+    with pytest.raises(MXNetError, match="MXNET_HEARTBEAT_INTERVAL"):
+        mx.kv.create("dist_sync")
+    monkeypatch.setenv("MXNET_HEARTBEAT_INTERVAL", "-1")
+    with pytest.raises(MXNetError, match="MXNET_HEARTBEAT_INTERVAL"):
+        mx.kv.create("dist_sync")
+    monkeypatch.setenv("MXNET_HEARTBEAT_INTERVAL", "0.25")
+    monkeypatch.setenv("MXNET_ELASTIC", "1")
+    monkeypatch.setenv("MXNET_DEAD_RANK_TIMEOUT", "0")
+    with pytest.raises(MXNetError, match="MXNET_DEAD_RANK_TIMEOUT"):
+        mx.kv.create("dist_sync")
+    monkeypatch.setenv("MXNET_DEAD_RANK_TIMEOUT", "5")
+    kv = mx.kv.create("dist_sync")  # valid values construct fine
+    assert kv._hb_interval == 0.25
+
+
+def test_both_consumers_read_unified_vars(monkeypatch, tmp_path):
+    """The heartbeat writer cadence AND the staleness scan resolve
+    through the new config vars (not the old scattered literals)."""
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_DIR", str(hb))
+    monkeypatch.setenv("MXNET_HEARTBEAT_INTERVAL", "0.1")
+    monkeypatch.setenv("MXNET_DEAD_RANK_TIMEOUT", "2.5")
+    kv = mx.kv.create("dist_sync")
+    assert kv._hb_interval == 0.1
+    time.sleep(0.3)
+    assert os.path.exists(hb / "hb_0")  # writer running on the cadence
+
+    class TwoWorkerView(type(kv)):
+        @property
+        def num_workers(self):
+            return 2
+
+    kv.__class__ = TwoWorkerView
+    # a peer 3s stale is dead under the 2.5s default resolved from env
+    old = time.time() - 3
+    (hb / "hb_1").write_text("x")
+    os.utime(hb / "hb_1", (old, old))
+    assert kv.dead_ranks(ranks=[0, 1]) == [1]
+    assert kv.get_num_dead_node() == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: heartbeat-based death detection as a unit
+# ---------------------------------------------------------------------------
+
+def test_dead_ranks_stale_never_wrote_and_clock_skew(monkeypatch, tmp_path):
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_DIR", str(hb))
+    kv = mx.kv.create("dist_sync")
+
+    class FourWorkerView(type(kv)):
+        @property
+        def num_workers(self):
+            return 4
+
+    kv.__class__ = FourWorkerView
+    now = time.time()
+    # rank 1: fresh -> alive
+    (hb / "hb_1").write_text("x")
+    # rank 2: stale mtime -> dead
+    (hb / "hb_2").write_text("x")
+    os.utime(hb / "hb_2", (now - 100, now - 100))
+    # rank 3: never wrote -> dead
+    assert kv.dead_ranks(timeout=5, ranks=range(4)) == [2, 3]
+    # clock skew: a peer whose filesystem clock runs AHEAD of ours must
+    # never be accused — future mtimes count as fresh
+    (hb / "hb_3").write_text("x")
+    os.utime(hb / "hb_3", (now + 50, now + 50))
+    assert kv.dead_ranks(timeout=5, ranks=range(4)) == [2]
+    # our own rank is alive by construction even with no file
+    assert 0 not in kv.dead_ranks(timeout=0.5, ranks=range(4))
+    # check_peers raises the verdict form
+    monkeypatch.setenv("MXNET_ELASTIC", "1")
+    kv._elastic = True
+    kv._active = [0, 1, 2]
+    with pytest.raises(DeadRankError) as ei:
+        kv.check_peers()
+    assert ei.value.dead_ranks == [2]
+
+
+# ---------------------------------------------------------------------------
+# membership ledger + epoch consensus
+# ---------------------------------------------------------------------------
+
+def test_membership_bootstrap_remesh_and_join(tmp_path):
+    m0 = Membership(str(tmp_path), rank=0)
+    m1 = Membership(str(tmp_path), rank=1)
+    rec = m0.bootstrap(active=[0, 1], world=2,
+                       addrs={0: ("127.0.0.1", 1), 1: ("127.0.0.1", 2)},
+                       secret=b"\x01\x02")
+    assert rec["epoch"] == 0
+    assert m1.read()["active"] == [0, 1]
+    # bootstrap is idempotent — a second call can't fork the ledger
+    assert m1.bootstrap([1], 1, {}, b"")["epoch"] == 0
+
+    # scale-down consensus: the lone survivor (rank 0) convicts rank 1
+    new = m0.remesh(dead=[1], is_alive=lambda r: r == 0, timeout=5)
+    assert new["epoch"] == 1 and new["active"] == [0]
+    assert list(new["addrs"]) == ["0"]  # dead shard dropped
+    assert new["secret"] == rec["secret"]
+
+    # scale-up: the returned rank requests, the survivor admits
+    m1.request_join()
+    assert m0.pending_joins() == [1]
+    admitted = m0.admit([1])
+    assert admitted["epoch"] == 2 and admitted["active"] == [0, 1]
+    got = m1.await_epoch(1, timeout=5)
+    assert got["epoch"] == 2 and 1 in got["active"]
+    m1.clear_join()
+    assert m0.pending_joins() == []
+
+
+def test_membership_remesh_excluded_survivor_refuses(tmp_path):
+    """A live rank that the committed epoch excludes must stop, not
+    keep training against a world that fenced it out."""
+    m0 = Membership(str(tmp_path), rank=0)
+    m1 = Membership(str(tmp_path), rank=1)
+    m0.bootstrap(active=[0, 1], world=2, addrs={}, secret=b"")
+    m0.remesh(dead=[1], is_alive=lambda r: r == 0, timeout=5)
+    with pytest.raises(MXNetError, match="declared us dead|considers us"):
+        m1.remesh(dead=[0], is_alive=lambda r: True, timeout=1)
+
+
+# ---------------------------------------------------------------------------
+# chaos harness
+# ---------------------------------------------------------------------------
+
+def test_chaos_validation_is_loud(monkeypatch):
+    monkeypatch.setenv("MXNET_CHAOS_KILL_STEP", "banana")
+    with pytest.raises(MXNetError, match="MXNET_CHAOS_KILL_STEP"):
+        Chaos()
+    monkeypatch.setenv("MXNET_CHAOS_KILL_STEP", "-3")
+    with pytest.raises(MXNetError, match="MXNET_CHAOS_KILL_STEP"):
+        Chaos()
+    monkeypatch.delenv("MXNET_CHAOS_KILL_STEP")
+    monkeypatch.setenv("MXNET_CHAOS_DEAD_RANKS", "1,x")
+    with pytest.raises(MXNetError, match="MXNET_CHAOS_DEAD_RANKS"):
+        Chaos()
+    monkeypatch.delenv("MXNET_CHAOS_DEAD_RANKS")
+    monkeypatch.setenv("MXNET_CHAOS_TORN_SOCKET", "0")
+    with pytest.raises(MXNetError, match="MXNET_CHAOS_TORN_SOCKET"):
+        Chaos()
+
+
+def test_chaos_dead_rank_injection_fires_once(monkeypatch):
+    monkeypatch.setenv("MXNET_CHAOS_DEAD_RANK_STEP", "3")
+    monkeypatch.setenv("MXNET_CHAOS_DEAD_RANKS", "2,5")
+    ch = Chaos()
+    for s in range(3):
+        ch.on_step(s)
+    with pytest.raises(DeadRankError) as ei:
+        ch.on_step(3)
+    assert ei.value.dead_ranks == [2, 5]
+    ch.on_step(4)  # one-shot: training continues after recovery
+
+
+def test_chaos_rank_filter_and_slow(monkeypatch):
+    monkeypatch.setenv("MXNET_CHAOS_DEAD_RANK_STEP", "0")
+    monkeypatch.setenv("MXNET_CHAOS_RANK", "1")
+    ch = Chaos()
+    ch.on_step(0, rank=0)  # filtered: no raise
+    with pytest.raises(DeadRankError):
+        ch.on_step(0, rank=1)
+    monkeypatch.setenv("MXNET_CHAOS_SLOW_RANK", "0.05")
+    monkeypatch.delenv("MXNET_CHAOS_DEAD_RANK_STEP")
+    ch = Chaos()
+    t0 = time.perf_counter()
+    ch.on_step(0, rank=1)
+    assert time.perf_counter() - t0 >= 0.05
+
+
+def test_chaos_heartbeat_stall_consumed_once(monkeypatch):
+    monkeypatch.setenv("MXNET_CHAOS_HEARTBEAT_STALL", "2.5")
+    ch = Chaos()
+    assert ch.heartbeat_stall_s() == 2.5
+    assert ch.heartbeat_stall_s() == 0.0  # one-shot fault
+
+
+def test_elastic_barrier_dead_peer_raises_fast(monkeypatch, tmp_path):
+    """The tentpole's hang-to-verdict promotion: an elastic barrier
+    whose missing peer is heartbeat-stale raises DeadRankError within
+    the dead-rank timeout instead of waiting forever."""
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_DIR", str(hb))
+    monkeypatch.setenv("MXNET_ELASTIC", "1")
+    monkeypatch.setenv("MXNET_DEAD_RANK_TIMEOUT", "0.5")
+    kv = mx.kv.create("dist_sync")
+    kv._active = [0, 1]
+    (hb / "hb_1").write_text("x")
+    old = time.time() - 10
+    os.utime(hb / "hb_1", (old, old))
+    t0 = time.perf_counter()
+    with pytest.raises(DeadRankError) as ei:
+        kv._elastic_barrier()
+    assert ei.value.dead_ranks == [1]
+    assert time.perf_counter() - t0 < 5.0
+
+
+def test_elastic_barrier_straggler_is_not_a_death(monkeypatch, tmp_path):
+    """A live-but-slow peer (fresh heartbeat, late stamp) must NOT be
+    convicted: the barrier completes once the stamp lands."""
+    import threading
+
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_DIR", str(hb))
+    monkeypatch.setenv("MXNET_ELASTIC", "1")
+    monkeypatch.setenv("MXNET_DEAD_RANK_TIMEOUT", "1.0")
+    monkeypatch.setenv("MXNET_WATCHDOG_DEADLINE", "0.2")
+    kv = mx.kv.create("dist_sync")
+    kv._active = [0, 1]
+
+    def late_peer():
+        # keep the peer's heartbeat fresh, stamp the barrier late
+        for _ in range(6):
+            (hb / "hb_1").write_text("x")
+            time.sleep(0.1)
+        (hb / "eb_0_1_1").write_text("x")
+
+    t = threading.Thread(target=late_peer)
+    t.start()
+    kv._elastic_barrier()  # waits past the watchdog log, no verdict
+    t.join()
+
+
+def test_chaos_singleton_tracks_env(monkeypatch):
+    a = get_chaos()
+    assert not a.armed
+    monkeypatch.setenv("MXNET_CHAOS_SLOW_RANK", "0.01")
+    b = get_chaos()
+    assert b is not a and b.armed
+
+
+# ---------------------------------------------------------------------------
+# parameter-server epoch fencing + bounded reconnect
+# ---------------------------------------------------------------------------
+
+def test_ps_epoch_fencing_and_remesh():
+    from mxnet_tpu.ps import ParameterServer, ShardedPSClient
+
+    srv = ParameterServer(secret=b"s" * 32, num_workers=2, sync=True,
+                          sync_wait_timeout=2.0)
+    try:
+        cl = ShardedPSClient([("127.0.0.1", srv.port)], secret=b"s" * 32,
+                             worker=0)
+        cl.init("w", np.zeros(4, np.float32))
+        cl.push_sync("w", np.ones(4, np.float32))
+        # a frame from ANOTHER membership epoch is rejected — the fence
+        # that keeps a dead/returning rank's stale traffic out
+        stale = ShardedPSClient([("127.0.0.1", srv.port)],
+                                secret=b"s" * 32, worker=1)
+        stale.set_epoch(7)
+        with pytest.raises(MXNetError, match="stale membership epoch"):
+            stale.push_sync("w", np.ones(4, np.float32))
+        # remesh: epoch advances, quorum shrinks to 1, store resets
+        cl.remesh(epoch=1, num_workers=1, reset=True)
+        with pytest.raises(MXNetError, match="uninitialized"):
+            cl.pull("w", shape=(4,), dtype=np.float32)
+        cl.init("w", np.full(4, 5.0, np.float32))
+        cl.push_sync("w", np.ones(4, np.float32))  # 1-worker round closes
+        out = cl.pull("w", shape=(4,), dtype=np.float32, min_round=1)
+        np.testing.assert_allclose(out, np.ones(4))
+        # duplicate remesh (another survivor) is a no-op; regression is
+        # refused
+        cl.remesh(epoch=1, num_workers=1)
+        with pytest.raises(MXNetError, match="refused"):
+            cl.remesh(epoch=0, num_workers=2)
+    finally:
+        srv.close()
+
+
+def test_ps_client_reconnects_with_backoff(monkeypatch):
+    """A torn frame mid-send (chaos injection) must be healed by the
+    bounded reconnect: the op retries on a fresh socket, the server
+    never sees a half-applied push, and ps.reconnects counts it."""
+    from mxnet_tpu import profiler as prof
+    from mxnet_tpu.ps import ParameterServer, ShardedPSClient
+
+    srv = ParameterServer(secret=b"s" * 32, num_workers=1)
+    try:
+        monkeypatch.setenv("MXNET_CHAOS_TORN_SOCKET", "3")
+        reset_chaos()
+        before = prof.metrics_summary().get("counters", {}).get(
+            "ps.reconnects", 0)
+        cl = ShardedPSClient([("127.0.0.1", srv.port)], secret=b"s" * 32,
+                             worker=0)
+        cl.init("w", np.zeros(4, np.float32))        # frame 1
+        cl.push("w", np.ones(4, np.float32))          # frame 2
+        cl.push("w", np.ones(4, np.float32))          # frame 3: torn
+        out = cl.pull("w", shape=(4,), dtype=np.float32)
+        np.testing.assert_allclose(out, np.ones(4))   # applied ONCE
+        assert cl.clients[0].num_applied("w") == 2
+        after = prof.metrics_summary().get("counters", {}).get(
+            "ps.reconnects", 0)
+        assert after == before + 1
+    finally:
+        srv.close()
+
+
+def test_ps_reconnect_budget_validation(monkeypatch):
+    from mxnet_tpu.ps import reconnect_budget
+
+    monkeypatch.setenv("MXNET_KVSTORE_RECONNECTS", "banana")
+    with pytest.raises(MXNetError, match="MXNET_KVSTORE_RECONNECTS"):
+        reconnect_budget()
+    monkeypatch.setenv("MXNET_KVSTORE_RECONNECTS", "-1")
+    with pytest.raises(MXNetError, match="MXNET_KVSTORE_RECONNECTS"):
+        reconnect_budget()
+    monkeypatch.setenv("MXNET_KVSTORE_RECONNECTS", "2")
+    assert reconnect_budget() == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: CheckpointManager emergency-save vs rollback re-entrancy
+# ---------------------------------------------------------------------------
+
+def test_sigterm_during_rollback_defers_emergency_save(tmp_path,
+                                                       monkeypatch):
+    mgr = mx.CheckpointManager(str(tmp_path), every_n_steps=0, keep=2,
+                               rank=0, num_shards=1)
+    calls = []
+    monkeypatch.setattr(mgr, "save",
+                        lambda *a, **k: calls.append(k.get("reason")))
+    monkeypatch.setattr(os, "kill", lambda *a: calls.append("exit"))
+    mgr._module = object()
+    mgr._step = 3
+    with mgr.rollback():
+        mgr._on_signal(signal.SIGTERM, None)
+        # mid-rollback: the handler must only latch, never save half-
+        # restored state (the re-entrancy race this guard closes)
+        assert calls == []
+        assert mgr._preempted
+    # at the guard's exit — a consistent boundary — exactly one
+    # emergency save runs, then the signal is re-raised
+    assert calls == ["preempt", "exit"]
+
+
+def test_emergency_exit_is_reentrancy_safe(tmp_path, monkeypatch):
+    mgr = mx.CheckpointManager(str(tmp_path), every_n_steps=0, keep=2,
+                               rank=0, num_shards=1)
+    calls = []
+
+    def fake_save(*a, **k):
+        calls.append("save")
+        if len(calls) == 1:
+            # a second SIGTERM lands while the first emergency save is
+            # still writing — must NOT start a second save
+            mgr._on_signal(signal.SIGTERM, None)
+
+    monkeypatch.setattr(mgr, "save", fake_save)
+    monkeypatch.setattr(os, "kill", lambda *a: calls.append("exit"))
+    mgr._module = object()
+    mgr._step = 1
+    mgr._on_signal(signal.SIGTERM, None)
+    assert calls == ["save", "exit"]
+
+
+def test_step_abandoned_unlatches_deferred_save(tmp_path, monkeypatch):
+    mgr = mx.CheckpointManager(str(tmp_path), every_n_steps=0, keep=2,
+                               rank=0, num_shards=1)
+    calls = []
+    monkeypatch.setattr(mgr, "save",
+                        lambda *a, **k: calls.append("save"))
+    monkeypatch.setattr(os, "kill", lambda *a: calls.append("exit"))
+    mgr._module = object()
+    mgr._step = 1
+    mgr.step_begin()
+    mgr._on_signal(signal.SIGTERM, None)
+    assert calls == []  # mid-step: deferred
+    # the step dies to a DeadRankError — without step_abandoned the
+    # latch would park the emergency save forever
+    mgr.step_abandoned()
+    assert not mgr._in_step
+
+
+# ---------------------------------------------------------------------------
+# resume-in-place: injected DeadRankError → rollback → resume (tier-1
+# smoke for the multi-process chaos drill)
+# ---------------------------------------------------------------------------
+
+def _sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _train_once(ckpt_dir, monkeypatch, chaos_step=None):
+    if chaos_step is not None:
+        monkeypatch.setenv("MXNET_ELASTIC", "1")
+        monkeypatch.setenv("MXNET_CHAOS_DEAD_RANK_STEP", str(chaos_step))
+    else:
+        monkeypatch.delenv("MXNET_ELASTIC", raising=False)
+        monkeypatch.delenv("MXNET_CHAOS_DEAD_RANK_STEP", raising=False)
+    reset_chaos()
+    rng = np.random.RandomState(3)
+    X = rng.randn(32, 8).astype(np.float32)
+    y = rng.randint(0, 4, 32).astype(np.float32)
+    mx.random.seed(11)
+    np.random.seed(11)
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(_sym(), context=mx.cpu())
+    mgr = mx.CheckpointManager(ckpt_dir, every_n_steps=2,
+                               async_save=False, keep=10)
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(rnd_type="gaussian"),
+            eval_metric="acc", checkpoint=mgr)
+    mgr.close()
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}
+
+
+def test_dead_rank_rollback_resume_bitexact(tmp_path, monkeypatch):
+    """The single-process smoke of the chaos drill: an injected
+    DeadRankError mid-epoch makes fit roll back to the last committed
+    checkpoint (params + momentum + RNG + data position) and resume —
+    the final weights must BIT-match an uninterrupted run (replay from
+    committed state is deterministic)."""
+    ref = _train_once(str(tmp_path / "a"), monkeypatch)
+    got = _train_once(str(tmp_path / "b"), monkeypatch, chaos_step=5)
+    assert set(ref) == set(got)
+    for k in ref:
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=k)
+
+
+def test_dead_rank_without_checkpoint_is_loud(monkeypatch):
+    monkeypatch.setenv("MXNET_ELASTIC", "1")
+    monkeypatch.setenv("MXNET_CHAOS_DEAD_RANK_STEP", "1")
+    reset_chaos()
+    rng = np.random.RandomState(3)
+    X = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, 16).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(_sym(), context=mx.cpu())
+    with pytest.raises(MXNetError, match="CheckpointManager"):
+        mod.fit(it, num_epoch=1, optimizer="sgd")
+
+
+# ---------------------------------------------------------------------------
+# tentpole: ZeRO-1 shard re-scatter across MeshPlans (dp' < dp)
+# ---------------------------------------------------------------------------
+
+def _make_mesh_mod(ndev, data):
+    import jax
+
+    from mxnet_tpu import parallel
+
+    X, y = data
+    mx.random.seed(7)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    mod.set_mesh_plan(parallel.MeshPlan(jax.devices()[:ndev]))
+    mod.init_optimizer(kvstore="tpu", optimizer="adam",
+                       optimizer_params={"learning_rate": 0.05})
+    return mod, it
+
+
+def _run_steps(mod, it, skip, n):
+    it.reset()
+    done = 0
+    for b in it:
+        if done >= skip + n:
+            break
+        if done >= skip:
+            mod.forward_backward(b)
+            mod.update()
+        done += 1
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}
+
+
+def test_module_remesh_rescatters_zero_shards(monkeypatch):
+    """Train on a dp=4 mesh (ZeRO state sharded 4-way), lose half the
+    devices, Module.remesh to dp'=2: the optimizer state re-scatters
+    through the layout-independent gather/load path and training
+    continues — final weights must match a never-interrupted dp=4 run
+    (the update math is layout-independent up to fp reassociation)."""
+    import jax
+
+    from mxnet_tpu import parallel
+
+    monkeypatch.setenv("MXNET_ZERO", "1")
+    rng = np.random.RandomState(3)
+    data = (rng.randn(16 * 8, 8).astype(np.float32),
+            rng.randint(0, 4, 16 * 8).astype(np.float32))
+
+    mod_a, it_a = _make_mesh_mod(4, data)
+    _run_steps(mod_a, it_a, 0, 4)
+    ref = _run_steps(mod_a, it_a, 4, 4)
+
+    mod_b, it_b = _make_mesh_mod(4, data)
+    _run_steps(mod_b, it_b, 0, 4)
+    assert mod_b._zero and mod_b._fused_state is not None
+    mod_b.remesh(parallel.MeshPlan(jax.devices()[:2]))
+    assert mod_b._mesh_plan.dp == 2
+    got = _run_steps(mod_b, it_b, 4, 4)
+    assert mod_b._zero, "ZeRO must re-arm on the new plan"
+    for k in ref:
+        np.testing.assert_allclose(ref[k], got[k], rtol=2e-5, atol=1e-6,
+                                   err_msg=k)
+    # the re-scattered state really is dp'=2-sharded on device
+    leaf = jax.tree_util.tree_leaves(mod_b._fused_state)[0]
+    shard = leaf.sharding.shard_shape(tuple(leaf.shape))
+    assert shard[0] * 2 == leaf.shape[0], (shard, leaf.shape)
+
+
+def test_module_remesh_scale_back_up(monkeypatch):
+    """dp=2 → dp'=4 (the regained-devices direction) re-scatters the
+    other way and keeps training equivalent."""
+    import jax
+
+    from mxnet_tpu import parallel
+
+    monkeypatch.setenv("MXNET_ZERO", "1")
+    rng = np.random.RandomState(4)
+    data = (rng.randn(16 * 6, 8).astype(np.float32),
+            rng.randint(0, 4, 16 * 6).astype(np.float32))
+    mod_a, it_a = _make_mesh_mod(2, data)
+    _run_steps(mod_a, it_a, 0, 3)
+    ref = _run_steps(mod_a, it_a, 3, 3)
+
+    mod_b, it_b = _make_mesh_mod(2, data)
+    _run_steps(mod_b, it_b, 0, 3)
+    mod_b.remesh(parallel.MeshPlan(jax.devices()[:4]))
+    got = _run_steps(mod_b, it_b, 3, 3)
+    for k in ref:
+        np.testing.assert_allclose(ref[k], got[k], rtol=2e-5, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_module_remesh_refuses_update_on_kvstore(tmp_path):
+    import jax
+
+    from mxnet_tpu import parallel
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, 16).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(mx.initializer.Uniform(0.1))
+    kv = mx.kv.create("local")
+    mod.init_optimizer(kvstore=kv, optimizer="sgd")
+    mod._update_on_kvstore = True
+    with pytest.raises(MXNetError, match="DistKVStore.remesh"):
+        mod.remesh(parallel.MeshPlan(jax.devices()[:2]))

@@ -170,7 +170,10 @@ def test_env_var_catalog():
                 continue
             src = open(os.path.join(root, f)).read()
             used.update(re.findall(r"MXNET_[A-Z_]+", src))
-    used.discard("MXNET_")  # the prefix mention in base.py docs
+    # family-wildcard mentions in docs/comments ("MXNET_CKPT_*",
+    # "MXNET_CHAOS_*") regex-capture as a trailing-underscore token —
+    # they reference a declared family, not an undeclared var
+    used = {u for u in used if not u.endswith("_")}
     missing = used - cat
     assert not missing, f"undeclared env vars: {sorted(missing)}"
     # catalog answers queries
