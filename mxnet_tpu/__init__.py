@@ -55,7 +55,11 @@ from . import rtc
 from . import predictor
 from .predictor import Predictor
 from . import serving
-from .serving import InferenceEngine, DecodeEngine, EngineClosedError
+from .serving import (InferenceEngine, DecodeEngine, EngineClosedError,
+                      ReplicaHarness)
+from . import wire
+from . import fleet
+from .fleet import Router, FleetClient, ShedError
 from . import kv_cache
 from . import sequence
 from . import monitor

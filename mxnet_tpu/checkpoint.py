@@ -70,7 +70,7 @@ from .base import MXNetError
 __all__ = [
     "CheckpointManager", "atomic_save", "atomic_write_bytes",
     "list_checkpoints", "read_commit", "verify_checkpoint", "load_shard",
-    "CkptInfo", "FORMAT",
+    "publish_params", "load_latest_params", "CkptInfo", "FORMAT",
 ]
 
 FORMAT = "mxnet_tpu-ckpt-v1"
@@ -304,6 +304,91 @@ def load_shard(path: str, rank: int) -> Dict[str, Any]:
     if not isinstance(state, dict) or state.get("format") != FORMAT:
         raise MXNetError(f"unrecognized snapshot format in {shard!r}")
     return state
+
+
+# ---------------------------------------------------------------------------
+# weight publish / subscribe — the serving fleet's swap source
+# ---------------------------------------------------------------------------
+
+
+def publish_params(directory: str, params: Dict[str, Any], step: int,
+                   aux_params: Optional[Dict[str, Any]] = None) -> str:
+    """Write a COMMITTED params-only checkpoint ``ckpt-<step>`` under
+    ``directory`` — the write side of the serving fleet's weight-swap
+    handoff.  Same shard + checksummed COMMIT-manifest format the
+    training :class:`CheckpointManager` commits, so
+    ``Router.swap_weights`` can point replicas at either a training
+    run's checkpoint root or a publish made here.  Atomic: readers see
+    the old newest checkpoint or the new one, never a torn directory.
+    Returns the committed path; refuses to overwrite an existing step.
+    """
+    os.makedirs(directory, exist_ok=True)
+    final = os.path.join(directory, f"{_DIR_PREFIX}{int(step):012d}")
+    if os.path.isdir(final):
+        raise MXNetError(f"checkpoint step {step} already committed at "
+                         f"{final}; publish a higher step")
+    tmp = final + _TMP_SUFFIX
+    os.makedirs(tmp, exist_ok=True)
+    snap = {
+        "format": FORMAT, "step": int(step), "epoch": 0, "nbatch": 0,
+        "rank": 0, "num_shards": 1, "reason": "publish",
+        "wall_time": time.time(),
+        "arg_params": dict(params),
+        "aux_params": dict(aux_params or {}),
+        "optimizer": None, "rng": None, "iter_state": None,
+    }
+    blob = pickle.dumps(_to_host_tree(snap), protocol=4)
+    sha = hashlib.sha256(blob).hexdigest()
+    atomic_write_bytes(os.path.join(tmp, _shard_name(0)), blob)
+    manifest = {"format": FORMAT, "step": int(step), "num_shards": 1,
+                "shards": {"00000": {"sha256": sha, "bytes": len(blob),
+                                     "step": int(step)}},
+                "wall_time": time.time()}
+    atomic_write_bytes(os.path.join(tmp, _COMMIT_FILE),
+                       json.dumps(manifest, indent=1).encode())
+    _fsync_dir(tmp)
+    os.rename(tmp, final)
+    _fsync_dir(directory)
+    return final
+
+
+def load_latest_params(path: str):
+    """Read-side publish helper: resolve ``path`` (one committed
+    checkpoint directory, or a root containing ``ckpt-*`` dirs) to the
+    newest committed, checksum-clean checkpoint and return
+    ``(params, step, ckpt_path)`` with arg and aux parameters MERGED
+    into one host-array dict — the shape ``DecodeEngine``/``Predictor``
+    construction wants.  Works on training checkpoints (optimizer/RNG/
+    iterator payloads ignored) and on :func:`publish_params` output
+    alike.  A corrupt newest checkpoint falls back to the previous
+    committed one; no usable checkpoint raises."""
+    candidates: List[str] = []
+    if os.path.isfile(os.path.join(path, _COMMIT_FILE)):
+        candidates = [path]
+    else:
+        candidates = [i.path for i in reversed(list_checkpoints(path))
+                      if i.committed]
+    last_err: Optional[MXNetError] = None
+    for cand in candidates:
+        try:
+            state = load_shard(cand, 0)
+        except MXNetError as exc:
+            logging.warning("[ckpt] %s unusable for weight load (%s); "
+                            "trying the previous committed checkpoint",
+                            cand, exc)
+            last_err = exc
+            continue
+        params = {k: np.asarray(v)
+                  for k, v in state.get("arg_params", {}).items()}
+        for k, v in (state.get("aux_params") or {}).items():
+            params[k] = np.asarray(v)
+        if not params:
+            last_err = MXNetError(f"checkpoint {cand} has no parameters")
+            continue
+        return params, int(state["step"]), cand
+    detail = f": {last_err}" if last_err is not None else ""
+    raise MXNetError(
+        f"no committed, checksum-clean checkpoint under {path!r}{detail}")
 
 
 # ---------------------------------------------------------------------------

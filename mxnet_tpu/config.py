@@ -343,6 +343,36 @@ register_env(
     "bucket).  Unset: kv_block-sized doubling ladder up to max_len.  "
     "Malformed ladders raise at engine construction.")
 register_env(
+    "MXNET_FLEET_REPLICAS", 2, int,
+    "Replica-process count for fleet.launch_local_fleet / "
+    "tools/bench_fleet.py when none is given explicitly.  Each replica "
+    "wraps one serving engine (InferenceEngine or DecodeEngine) behind "
+    "the fleet wire.  Values < 1 or garbage raise at construction.")
+register_env(
+    "MXNET_FLEET_SHED_DEADLINE_MS", 0.0, float,
+    "Default per-request deadline budget (milliseconds) the fleet "
+    "Router applies to requests that carry none: a request the learned "
+    "per-bucket cost model says cannot finish inside its budget is "
+    "rejected with a typed ShedError, and under overload the pending "
+    "queue sheds oldest-deadline-first.  0 (default): no implicit "
+    "deadline — only explicit per-request deadlines shed.  Negative or "
+    "garbage values raise at Router construction.")
+register_env(
+    "MXNET_FLEET_RETRY_BUDGET", 2, int,
+    "Re-dispatches one fleet request survives before its client sees "
+    "the failure: a dead replica's in-flight requests are retried on "
+    "survivors up to this many times (delivery stays exactly-once via "
+    "the router's ticket latch; decode retries re-sample bit-"
+    "identically from the router-stamped seed).  0 disables retries.  "
+    "Negative or garbage values raise at Router construction.")
+register_env(
+    "MXNET_FLEET_SWAP_DRAIN_TIMEOUT", 60.0, float,
+    "Seconds Router.swap_weights waits for a draining replica's "
+    "in-flight requests to deliver before aborting the rolling weight "
+    "swap (the replica resumes on its old weights; replicas already "
+    "swapped stay swapped).  Must be >= 0.1; garbage raises at Router "
+    "construction.")
+register_env(
     "MXNET_TEST_DEVICE", None, str,
     "Device the test utilities bind to (test_utils.default_context; "
     "the reference's MXNET_TEST_DEVICE).  Unset: the ambient current "

@@ -43,7 +43,8 @@ from typing import Dict, List, Optional, Sequence
 from .base import MXNetError, get_env
 
 __all__ = ["DeadRankError", "Membership", "elastic_enabled",
-           "heartbeat_interval", "dead_rank_timeout"]
+           "heartbeat_interval", "dead_rank_timeout",
+           "HeartbeatWriter", "stale_ids"]
 
 _EPOCH_PREFIX = "epoch-"
 _PROPOSE_PREFIX = "propose-"
@@ -97,6 +98,86 @@ def elastic_enabled() -> bool:
     if val not in (0, 1):
         raise MXNetError(f"invalid MXNET_ELASTIC={val!r}: must be 0 or 1")
     return bool(val)
+
+
+class HeartbeatWriter:
+    """File-heartbeat liveness (the ps-lite heartbeat role): touch
+    ``<root>/<prefix><ident>`` every ``interval`` seconds on a daemon
+    thread.  Shared by the dist kvstore (one file per RANK) and the
+    serving fleet's replica processes (one file per REPLICA) — peers
+    whose file goes stale past :func:`dead_rank_timeout` count as
+    dead (:func:`stale_ids`).
+
+    ``chaos_ident`` opts the writer into the MXNET_CHAOS_HEARTBEAT_
+    STALL fault (chaos drills go silent long enough to be convicted).
+    """
+
+    def __init__(self, root: str, ident, prefix: str = "hb_",
+                 interval: Optional[float] = None, chaos_ident=None):
+        import threading
+
+        os.makedirs(root, exist_ok=True)
+        self.path = os.path.join(root, f"{prefix}{ident}")
+        self._interval = (heartbeat_interval() if interval is None
+                          else float(interval))
+        self._chaos_ident = chaos_ident
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._beat, daemon=True,
+            name=f"mxnet_tpu-heartbeat-{prefix}{ident}")
+        self._thread.start()
+
+    def _beat(self):
+        from .chaos import get_chaos
+
+        while not self._stop.is_set():
+            try:
+                with open(self.path, "w") as f:
+                    f.write(str(time.time()))
+            except OSError:
+                pass
+            if self._chaos_ident is not None:
+                # chaos: the delayed-heartbeat fault — go silent long
+                # enough for peers to (wrongly or rightly) convict us
+                stall = get_chaos().heartbeat_stall_s(
+                    rank=self._chaos_ident)
+                if stall:
+                    self._stop.wait(stall)
+            self._stop.wait(self._interval)
+
+    def stop(self, remove: bool = False):
+        """End the thread; ``remove`` also deletes the file so peers
+        convict immediately instead of after the staleness window."""
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        if remove:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+
+
+def stale_ids(root: str, ids, timeout: Optional[float] = None,
+              prefix: str = "hb_") -> List:
+    """Heartbeat-staleness scan → the sorted subset of ``ids`` whose
+    file under ``root`` is missing or older than ``timeout`` (default
+    :func:`dead_rank_timeout`).  Mtimes in the FUTURE (writer clock
+    ahead of ours on a shared filesystem) count as fresh — clock skew
+    must never accuse a live peer."""
+    if timeout is None:
+        timeout = dead_rank_timeout()
+    now = time.time()
+    dead = []
+    for i in ids:
+        path = os.path.join(root, f"{prefix}{i}")
+        try:
+            age = now - os.path.getmtime(path)
+        except OSError:
+            dead.append(i)  # never wrote a heartbeat
+            continue
+        if max(age, 0.0) > timeout:
+            dead.append(i)
+    return sorted(dead)
 
 
 class DeadRankError(MXNetError):

@@ -1,0 +1,1508 @@
+"""Multi-replica serving fleet: elastic router, admission control,
+zero-downtime weight swap.
+
+One engine process serves one chip's worth of streams and dies whole:
+a crash drops every in-flight request, and a weight update means
+downtime.  This module is the fleet tier above ``serving.py`` —
+Orca-style iteration-level serving extended from one scheduler to a
+routed fleet:
+
+* the **Router** speaks the ``wire.py`` length-prefixed frame protocol
+  (the ``ps.py`` wire — shared primitives, shared HMAC discipline for
+  structured payloads) to clients, and spreads requests over N engine
+  **replicas**, each a process wrapping an ``InferenceEngine`` or
+  ``DecodeEngine`` behind a :class:`ReplicaHarness`;
+* **health** is the PR-8 heartbeat-file machinery re-used verbatim:
+  every replica runs an ``elastic.HeartbeatWriter``, the router's
+  monitor runs the ``elastic.stale_ids`` staleness scan (missing or
+  stale = dead, future mtimes = alive), and a transport failure is
+  cross-checked against staleness before conviction;
+* a dead replica's in-flight requests are transparently **retried** on
+  a survivor.  Exactly-once is the PR-3 ticket discipline applied at
+  the delivery edge: a ticket retires only when its response reaches
+  the client, a retry is dispatched only for unretired tickets, and a
+  zombie's late answer finds its ticket retired and is dropped
+  (counted, never double-delivered).  Decode retries are **bit-exact**:
+  the router stamps every decode request with a deterministic sampling
+  seed, and replicas share the engine seed, so a survivor re-samples
+  exactly the tokens the dead replica would have produced — no
+  already-delivered token is ever re-sampled differently;
+* **admission control + deadline shedding**: the router tracks
+  per-replica queue depth and a PR-1-style learned per-bucket cost
+  model (EMA of measured service time per work-unit bucket).  A
+  request that provably cannot meet its deadline fails with a typed
+  :class:`ShedError`; under overload the pending queue sheds
+  oldest-deadline-first instead of letting p99 run away;
+* :meth:`Router.swap_weights` is the **zero-downtime rolling update**:
+  replicas drain one at a time (the rest keep serving), load the
+  newest committed, checksum-verified checkpoint
+  (``checkpoint.load_latest_params`` — a training run's checkpoint
+  root or a ``checkpoint.publish_params`` output), warm up, and
+  re-admit.  A swap drops zero requests.
+
+Wire security matches ``ps.py``: tensor frames are never pickled, and
+every structured control payload (drain/swap/stop) carries an
+HMAC-SHA256 keyed by the launcher-distributed secret, verified before
+parsing.
+
+See README "Multi-replica serving" for the architecture diagram and
+failure model; ``tools/bench_fleet.py`` runs the closed-loop sweep and
+the kill-one-replica acceptance drill.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import socketserver
+import struct
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import profiler
+from . import wire
+from .base import MXNetError
+from .elastic import (HeartbeatWriter, dead_rank_timeout,
+                      heartbeat_interval, stale_ids, _validated_env)
+
+__all__ = ["Router", "FleetClient", "ShedError", "ReplicaClient",
+           "ReplicaServer", "spawn_replica", "launch_local_fleet",
+           "read_endpoint", "write_secret", "read_secret"]
+
+# fleet wire ops (a separate op space from ps.py: different servers,
+# same framing)
+(_F_SUBMIT, _F_RESULT, _F_CTRL, _F_CTRL_RESULT) = range(101, 105)
+
+# result status bytes
+_ST_OK, _ST_ERR, _ST_SHED = 0, 1, 2
+
+_K_INFER, _K_DECODE = 0, 1
+_NO_EOS = -(1 << 62)
+
+_log = logging.getLogger("mxnet_tpu.fleet")
+
+
+class ShedError(MXNetError):
+    """Typed admission-control rejection: the router determined this
+    request cannot (or should not) be served within its deadline —
+    shed NOW so the client can fail over / degrade, instead of
+    discovering the miss after the deadline already passed.  Carries
+    ``reason`` ('deadline' | 'expired' | 'overload')."""
+
+    def __init__(self, msg: str, reason: str = "deadline"):
+        self.reason = reason
+        super().__init__(msg)
+
+
+def fleet_env(name: str):
+    """MXNET_FLEET_* with loud at-construction validation (the
+    MXNET_CKPT_* pattern): garbage raises, defaults resolve through
+    the config catalog."""
+    minima = {"MXNET_FLEET_REPLICAS": 1,
+              "MXNET_FLEET_SHED_DEADLINE_MS": 0.0,
+              "MXNET_FLEET_RETRY_BUDGET": 0,
+              "MXNET_FLEET_SWAP_DRAIN_TIMEOUT": 0.1}
+    return _validated_env(name, minimum=minima[name])
+
+
+# ---------------------------------------------------------------------------
+# spec <-> wire
+# ---------------------------------------------------------------------------
+
+
+def _pack_spec(spec: Dict[str, Any]) -> bytes:
+    """Request payload: tensors ride the wire encoding, never pickle."""
+    if spec["kind"] == "infer":
+        inputs = spec["inputs"]
+        if len(inputs) > 0xFFFF:
+            raise MXNetError("too many inputs for one request")
+        body = bytearray([_K_INFER])
+        body += struct.pack("!H", len(inputs))
+        for name, arr in inputs.items():
+            body += wire.pack_key(name)
+            body += wire.pack_tensor(np.asarray(arr))
+        return bytes(body)
+    if spec["kind"] == "decode":
+        body = bytearray([_K_DECODE])
+        body += wire.U32.pack(int(spec["max_new"]))
+        temp = spec.get("temperature")
+        body += struct.pack("!d", -1.0 if temp is None else float(temp))
+        eos = spec.get("eos")
+        body += wire.I64.pack(_NO_EOS if eos is None else int(eos))
+        body += wire.U64.pack(int(spec.get("seed", 0)))
+        body += wire.pack_tensor(
+            np.asarray(spec["prompt"], dtype=np.int32))
+        return bytes(body)
+    raise MXNetError(f"unknown request kind {spec['kind']!r}")
+
+
+def _unpack_spec(buf: memoryview, off: int) -> Dict[str, Any]:
+    kind = buf[off]
+    off += 1
+    if kind == _K_INFER:
+        (n,) = struct.unpack_from("!H", buf, off)
+        off += 2
+        inputs = {}
+        for _ in range(n):
+            name, off = wire.unpack_key(buf, off)
+            arr, off = wire.unpack_tensor(buf, off)
+            inputs[name] = np.array(arr)  # own the buffer
+        return {"kind": "infer", "inputs": inputs}
+    if kind == _K_DECODE:
+        (max_new,) = wire.U32.unpack_from(buf, off)
+        off += 4
+        (temp,) = struct.unpack_from("!d", buf, off)
+        off += 8
+        (eos,) = wire.I64.unpack_from(buf, off)
+        off += 8
+        (seed,) = wire.U64.unpack_from(buf, off)
+        off += 8
+        prompt, off = wire.unpack_tensor(buf, off)
+        return {"kind": "decode", "prompt": np.array(prompt),
+                "max_new": int(max_new),
+                "temperature": None if temp < 0 else float(temp),
+                "eos": None if eos == _NO_EOS else int(eos),
+                "seed": int(seed)}
+    raise MXNetError(f"unknown wire request kind {kind}")
+
+
+def _pack_result(result) -> bytes:
+    """infer → list of output arrays; decode → one int32 token array."""
+    if isinstance(result, np.ndarray):
+        result = [result]
+    if len(result) > 0xFFFF:
+        raise MXNetError("too many outputs for one response")
+    body = bytearray(struct.pack("!H", len(result)))
+    for arr in result:
+        body += wire.pack_tensor(np.asarray(arr))
+    return bytes(body)
+
+
+def _unpack_result(buf: memoryview, off: int) -> List[np.ndarray]:
+    (n,) = struct.unpack_from("!H", buf, off)
+    off += 2
+    out = []
+    for _ in range(n):
+        arr, off = wire.unpack_tensor(buf, off)
+        out.append(np.array(arr))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# duplex connection: frames tagged by request id, responses out of order
+# ---------------------------------------------------------------------------
+
+
+class _Duplex:
+    """One socket, many in-flight requests.  Unlike the PS client's
+    FIFO ticket pipeline (one server thread per connection answers in
+    order), fleet responses complete OUT of order — a decode retires
+    whenever its stream does — so every frame carries a request id and
+    a reader thread matches responses to futures."""
+
+    def __init__(self, sock: socket.socket, name: str):
+        self._sock = sock
+        self._name = name
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()
+        self._futures: Dict[int, Future] = {}
+        self._next_id = 0
+        self._dead: Optional[BaseException] = None
+        self._on_death = None  # callback(exc), set before start()
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"mxnet_tpu-fleet-{name}")
+
+    def start(self):
+        self._reader.start()
+
+    def begin(self, op: int, body: bytes, parse) -> Future:
+        """Send ``op | req_id | body``; the Future resolves with
+        ``parse(status, payload_view)`` when the matching response
+        arrives.  A dead connection fails ALL outstanding futures."""
+        fut: Future = Future()
+        with self._lock:
+            if self._dead is not None:
+                raise MXNetError(
+                    f"fleet connection {self._name} is dead: "
+                    f"{self._dead}") from self._dead
+            rid = self._next_id
+            self._next_id += 1
+            self._futures[rid] = fut
+        fut._fleet_parse = parse  # type: ignore[attr-defined]
+        frame = bytes([op]) + wire.U64.pack(rid) + body
+        try:
+            with self._wlock:
+                wire.send_frame(self._sock, frame)
+        except BaseException as exc:
+            self._poison(exc)
+            raise
+        return fut
+
+    def _read_loop(self):
+        try:
+            while True:
+                resp = wire.recv_frame(self._sock)
+                (rid,) = wire.U64.unpack_from(resp, 1)
+                status = resp[9]
+                with self._lock:
+                    fut = self._futures.pop(rid, None)
+                if fut is None:
+                    continue  # cancelled/unknown — drop
+                parse = getattr(fut, "_fleet_parse", None)
+                try:
+                    val = parse(status, memoryview(resp)[10:])
+                except BaseException as exc:  # noqa: BLE001
+                    if fut.set_running_or_notify_cancel():
+                        fut.set_exception(exc)
+                    continue
+                if fut.set_running_or_notify_cancel():
+                    if isinstance(val, BaseException):
+                        fut.set_exception(val)
+                    else:
+                        fut.set_result(val)
+        except BaseException as exc:  # noqa: BLE001 — poison and exit
+            self._poison(exc)
+
+    def _poison(self, exc: BaseException):
+        with self._lock:
+            if self._dead is None:
+                self._dead = exc
+            futures, self._futures = self._futures, {}
+        for fut in futures.values():
+            if fut.set_running_or_notify_cancel():
+                fut.set_exception(ConnectionError(
+                    f"fleet connection {self._name} died: {exc}"))
+        cb = self._on_death
+        if cb is not None:
+            try:
+                cb(exc)
+            except Exception:  # noqa: BLE001 — observer only
+                pass
+
+    @property
+    def dead(self) -> Optional[BaseException]:
+        return self._dead
+
+    def close(self):
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _parse_submit_response(status: int, payload: memoryview):
+    if status == _ST_OK:
+        return _unpack_result(payload, 0)
+    msg = bytes(payload).decode(errors="replace")
+    if status == _ST_SHED:
+        head, _, detail = msg.partition(":")
+        return ShedError(detail.strip() or msg, reason=head or "deadline")
+    return MXNetError(msg)
+
+
+# ---------------------------------------------------------------------------
+# replica side: TCP server over a ReplicaHarness
+# ---------------------------------------------------------------------------
+
+
+class ReplicaServer:
+    """Serve ONE :class:`serving.ReplicaHarness` on the fleet wire.
+
+    SUBMIT frames feed the engine; the response frame is written from
+    the engine future's done-callback (out-of-order completion — a
+    per-connection write lock keeps frames whole).  CTRL frames
+    (signed JSON: drain / resume / swap / inflight / stats / stop) run
+    on a worker thread so a long drain never stalls the response
+    stream it is waiting on.  The server heartbeats
+    ``<fleet_dir>/hb_<rid>`` — the PR-8 liveness plane."""
+
+    def __init__(self, harness, rid: int, fleet_dir: Optional[str] = None,
+                 secret: bytes = b"", host: str = "127.0.0.1",
+                 port: int = 0):
+        self.harness = harness
+        self.rid = int(rid)
+        self._secret = secret
+        self._closing = threading.Event()
+        self._hb = None
+        if fleet_dir:
+            self._hb = HeartbeatWriter(fleet_dir, self.rid,
+                                       chaos_ident=self.rid)
+        server_self = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                wlock = threading.Lock()
+                try:
+                    while True:
+                        req = wire.recv_frame(self.request)
+                        server_self._dispatch(req, self.request, wlock)
+                except (ConnectionError, EOFError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"mxnet_tpu-fleet-replica-{rid}")
+        self._thread.start()
+
+    def _send(self, sock, wlock, op: int, rid: int, status: int,
+              payload: bytes):
+        frame = bytes([op]) + wire.U64.pack(rid) + bytes([status]) \
+            + payload
+        try:
+            with wlock:
+                wire.send_frame(sock, frame)
+        except OSError:
+            pass  # connection died; the router convicts via heartbeat
+
+    def _dispatch(self, buf: memoryview, sock, wlock):
+        op = buf[0]
+        (rid,) = wire.U64.unpack_from(buf, 1)
+        if op == _F_SUBMIT:
+            try:
+                spec = _unpack_spec(buf, 9)
+                if spec["kind"] == "infer":
+                    fut = self.harness.submit_infer(spec["inputs"])
+                else:
+                    fut = self.harness.submit_decode(
+                        spec["prompt"], spec["max_new"],
+                        temperature=spec["temperature"],
+                        eos_id=spec["eos"], seed=spec["seed"])
+            except BaseException as exc:  # noqa: BLE001 — to the wire
+                self._send(sock, wlock, _F_RESULT, rid, _ST_ERR,
+                           f"{type(exc).__name__}: {exc}".encode())
+                return
+
+            def done(f, _rid=rid):
+                exc = f.exception()
+                if exc is not None:
+                    self._send(sock, wlock, _F_RESULT, _rid, _ST_ERR,
+                               f"{type(exc).__name__}: {exc}".encode())
+                else:
+                    self._send(sock, wlock, _F_RESULT, _rid, _ST_OK,
+                               _pack_result(f.result()))
+
+            fut.add_done_callback(done)
+            return
+        if op == _F_CTRL:
+            try:
+                spec, _ = wire.unpack_signed_json(
+                    self._secret, buf, 9, "fleet control frame")
+            except BaseException as exc:  # noqa: BLE001 — to the wire
+                self._send(sock, wlock, _F_CTRL_RESULT, rid, _ST_ERR,
+                           f"{type(exc).__name__}: {exc}".encode())
+                return
+            threading.Thread(
+                target=self._ctrl, args=(spec, rid, sock, wlock),
+                daemon=True,
+                name=f"mxnet_tpu-fleet-ctrl-{spec.get('op')}").start()
+            return
+        self._send(sock, wlock, _F_RESULT, rid, _ST_ERR,
+                   f"unknown fleet op {op}".encode())
+
+    def _ctrl(self, spec: Dict, rid: int, sock, wlock):
+        try:
+            op = spec.get("op")
+            if op == "inflight":
+                out: Any = {"inflight": self.harness.inflight()}
+            elif op == "stats":
+                out = self.harness.stats()
+            elif op == "drain":
+                out = {"inflight": self.harness.drain(
+                    timeout=float(spec.get("timeout", 30.0)))}
+            elif op == "resume":
+                self.harness.resume()
+                out = {"ok": True}
+            elif op == "swap":
+                out = self.harness.swap(
+                    spec["ckpt_dir"],
+                    drain_timeout=float(spec.get("drain_timeout", 60.0)))
+            elif op == "stop":
+                out = {"ok": True}
+                self._closing.set()
+            else:
+                raise MXNetError(f"unknown fleet control op {op!r}")
+            self._send(sock, wlock, _F_CTRL_RESULT, rid, _ST_OK,
+                       json.dumps(out).encode())
+        except BaseException as exc:  # noqa: BLE001 — to the wire
+            self._send(sock, wlock, _F_CTRL_RESULT, rid, _ST_ERR,
+                       f"{type(exc).__name__}: {exc}".encode())
+        if self._closing.is_set():
+            self.close()
+
+    def wait_closed(self, timeout: Optional[float] = None) -> bool:
+        return self._closing.wait(timeout)
+
+    def close(self):
+        self._closing.set()
+        threading.Thread(target=self._server.shutdown,
+                         daemon=True).start()
+        self._server.server_close()
+        if self._hb is not None:
+            self._hb.stop(remove=True)
+        self.harness.close()
+
+
+class ReplicaClient:
+    """Router-side handle to a (remote) replica: the duck type the
+    Router schedules over — in-process fakes in the tests implement
+    the same surface without a socket."""
+
+    def __init__(self, rid: int, host: str, port: int,
+                 secret: bytes = b"", timeout: float = 30.0):
+        self.rid = int(rid)
+        t0 = time.monotonic()
+        while True:
+            try:
+                sock = socket.create_connection((host, port), timeout=10)
+                break
+            except OSError:
+                if time.monotonic() - t0 > timeout:
+                    raise MXNetError(
+                        f"cannot reach replica {rid} at {host}:{port}")
+                time.sleep(0.1)
+        sock.settimeout(None)
+        self._secret = secret
+        self._dx = _Duplex(sock, f"replica-{rid}")
+        self._dx.start()
+
+    def set_on_death(self, cb):
+        self._dx._on_death = cb
+
+    @property
+    def transport_dead(self) -> Optional[BaseException]:
+        return self._dx.dead
+
+    def submit(self, spec: Dict[str, Any]) -> Future:
+        return self._dx.begin(_F_SUBMIT, _pack_spec(spec),
+                              _parse_submit_response)
+
+    def _ctrl(self, obj: Dict, timeout: float = 120.0) -> Dict:
+        def parse(status, payload):
+            if status != _ST_OK:
+                return MXNetError(bytes(payload).decode(errors="replace"))
+            return json.loads(bytes(payload).decode())
+
+        body = wire.pack_signed_json(self._secret, obj)
+        return self._dx.begin(_F_CTRL, body, parse).result(timeout)
+
+    def inflight(self) -> int:
+        return int(self._ctrl({"op": "inflight"})["inflight"])
+
+    def drain(self, timeout: float = 30.0) -> int:
+        return int(self._ctrl({"op": "drain", "timeout": timeout},
+                              timeout=timeout + 30.0)["inflight"])
+
+    def resume(self):
+        self._ctrl({"op": "resume"})
+
+    def swap(self, ckpt_dir: str, drain_timeout: float = 60.0) -> Dict:
+        # warmup recompiles every bucket — allow it generous wall time
+        return self._ctrl({"op": "swap", "ckpt_dir": ckpt_dir,
+                           "drain_timeout": drain_timeout},
+                          timeout=drain_timeout + 1800.0)
+
+    def stats(self) -> Dict:
+        return self._ctrl({"op": "stats"})
+
+    def stop(self):
+        try:
+            self._ctrl({"op": "stop"}, timeout=10.0)
+        except Exception:  # noqa: BLE001 — best effort
+            pass
+
+    def close(self):
+        self._dx.close()
+
+
+# ---------------------------------------------------------------------------
+# replica process launch
+# ---------------------------------------------------------------------------
+
+
+def write_secret(fleet_dir: str, secret: bytes) -> str:
+    """Persist the wire secret for replica processes (0600 — the
+    membership-ledger convention for key material)."""
+    os.makedirs(fleet_dir, exist_ok=True)
+    path = os.path.join(fleet_dir, "secret")
+    from .checkpoint import atomic_write_bytes
+
+    atomic_write_bytes(path, secret.hex().encode())
+    try:
+        os.chmod(path, 0o600)
+    except OSError:
+        pass
+    return path
+
+
+def read_secret(fleet_dir: str) -> bytes:
+    try:
+        with open(os.path.join(fleet_dir, "secret")) as f:
+            return bytes.fromhex(f.read().strip())
+    except (OSError, ValueError):
+        return b""
+
+
+def read_endpoint(fleet_dir: str, rid: int,
+                  timeout: float = 120.0) -> Tuple[str, int]:
+    """Wait for replica ``rid``'s endpoint file (written once its
+    server is listening) → (host, port)."""
+    path = os.path.join(fleet_dir, f"ep_{rid}")
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            with open(path) as f:
+                host, port = f.read().strip().rsplit(":", 1)
+                return host, int(port)
+        except (OSError, ValueError):
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"replica {rid} never announced an endpoint in "
+                    f"{fleet_dir} within {timeout:.0f}s")
+            time.sleep(0.1)
+
+
+def spawn_replica(rid: int, fleet_dir: str, builder: str,
+                  builder_kwargs: Optional[Dict] = None,
+                  env: Optional[Dict[str, str]] = None) -> subprocess.Popen:
+    """Start one replica process: ``python -m mxnet_tpu.fleet`` imports
+    ``builder`` ("pkg.module:function"), calls it with
+    ``builder_kwargs`` to construct the engine, wraps it in a
+    ReplicaHarness, and serves until stopped (or until its parent
+    dies — replicas watch getppid, the io_pool orphan rule)."""
+    spec = {"rid": int(rid), "fleet_dir": fleet_dir, "builder": builder,
+            "kwargs": builder_kwargs or {}, "parent": os.getpid()}
+    child_env = dict(os.environ)
+    child_env.update(env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "mxnet_tpu.fleet", json.dumps(spec)],
+        env=child_env)
+
+
+def _replica_main(spec: Dict) -> int:
+    from .serving import ReplicaHarness
+    from .checkpoint import atomic_write_bytes
+
+    rid = int(spec["rid"])
+    fleet_dir = spec["fleet_dir"]
+    mod_name, _, fn_name = spec["builder"].partition(":")
+    import importlib
+
+    if mod_name.endswith(".py"):
+        # a script builder (tools/bench_fleet.py) — load by file path
+        import importlib.util
+
+        mspec = importlib.util.spec_from_file_location(
+            "_fleet_builder", mod_name)
+        module = importlib.util.module_from_spec(mspec)
+        mspec.loader.exec_module(module)
+    else:
+        module = importlib.import_module(mod_name)
+    builder = getattr(module, fn_name)
+    engine = builder(**spec.get("kwargs", {}))
+    harness = engine if isinstance(engine, ReplicaHarness) \
+        else ReplicaHarness(engine)
+    server = ReplicaServer(harness, rid, fleet_dir=fleet_dir,
+                           secret=read_secret(fleet_dir))
+    atomic_write_bytes(os.path.join(fleet_dir, f"ep_{rid}"),
+                       f"127.0.0.1:{server.port}".encode())
+    _log.warning("[fleet] replica %d serving on :%d (pid %d)",
+                 rid, server.port, os.getpid())
+    parent = int(spec.get("parent", 0))
+    while not server.wait_closed(timeout=1.0):
+        if parent and os.getppid() != parent:
+            _log.warning("[fleet] replica %d: parent died; exiting", rid)
+            server.close()
+            return 0
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+
+class _Ticket:
+    """One client request's life in the router: assigned → (retried)* →
+    delivered exactly once."""
+
+    __slots__ = ("tid", "spec", "deadline", "units", "attempts",
+                 "rid", "t_submit", "t_dispatch", "future", "delivered",
+                 "queued")
+
+    def __init__(self, tid, spec, deadline, units, future):
+        self.tid = tid
+        self.spec = spec
+        self.deadline = deadline      # absolute monotonic, or None
+        self.units = units            # work units (samples / new tokens)
+        self.attempts = 0
+        self.rid = None               # replica currently owning it
+        self.t_submit = time.monotonic()
+        self.t_dispatch = 0.0
+        self.future = future          # resolves toward the client
+        self.delivered = False        # retired: exactly-once latch
+        self.queued = True            # sitting in Router._pending
+
+
+class _ReplicaState:
+    __slots__ = ("handle", "outstanding", "draining", "dead", "swaps")
+
+    def __init__(self, handle):
+        self.handle = handle
+        self.outstanding: Dict[int, _Ticket] = {}
+        self.draining = False
+        self.dead = False
+        self.swaps = 0
+
+
+class Router:
+    """Spread requests over N replicas; survive replica death; shed by
+    deadline; roll weight swaps with zero dropped requests.
+
+    Parameters
+    ----------
+    replicas : list
+        Replica handles (:class:`ReplicaClient` or any in-process
+        object with the same surface: ``rid``, ``submit(spec) ->
+        Future``, ``inflight()``, ``drain()``, ``resume()``,
+        ``swap()``, ``stats()``, ``close()``).
+    fleet_dir : str, optional
+        The shared heartbeat directory replicas write ``hb_<rid>``
+        into; enables the staleness scan.  Without it only transport
+        failures convict a replica.
+    secret : bytes
+        HMAC key for structured control payloads (and the client
+        wire's server, when :meth:`serve` is called).
+    retry_budget : int
+        Re-dispatches a ticket survives before its client sees the
+        failure (env ``MXNET_FLEET_RETRY_BUDGET``).
+    default_deadline_ms : float
+        Deadline applied to requests that carry none; 0 = unbounded
+        (env ``MXNET_FLEET_SHED_DEADLINE_MS``).
+    replica_depth : int
+        Max tickets outstanding on one replica; beyond it requests
+        queue in the router (where they can still be shed/retried).
+    max_pending : int
+        Router queue bound; above it the pending queue sheds
+        oldest-deadline-first.
+    dead_timeout : float
+        Heartbeat staleness threshold (``MXNET_DEAD_RANK_TIMEOUT``).
+    """
+
+    def __init__(self, replicas, fleet_dir: Optional[str] = None,
+                 secret: bytes = b"", retry_budget: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 replica_depth: int = 8, max_pending: int = 1024,
+                 dead_timeout: Optional[float] = None):
+        if not replicas:
+            raise MXNetError("Router needs at least one replica")
+        self._fleet_dir = fleet_dir
+        self._secret = secret
+        self._retry_budget = int(
+            fleet_env("MXNET_FLEET_RETRY_BUDGET")
+            if retry_budget is None else retry_budget)
+        dl = (fleet_env("MXNET_FLEET_SHED_DEADLINE_MS")
+              if default_deadline_ms is None else default_deadline_ms)
+        self._default_deadline_s = float(dl) / 1e3 if dl else None
+        self._replica_depth = int(replica_depth)
+        self._max_pending = int(max_pending)
+        self._dead_timeout = (dead_rank_timeout() if dead_timeout is None
+                              else float(dead_timeout))
+        self._swap_drain_timeout = float(
+            fleet_env("MXNET_FLEET_SWAP_DRAIN_TIMEOUT"))
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._replicas: Dict[int, _ReplicaState] = {}
+        for h in replicas:
+            rid = int(h.rid)
+            if rid in self._replicas:
+                raise MXNetError(f"duplicate replica id {rid}")
+            self._replicas[rid] = _ReplicaState(h)
+            cb = getattr(h, "set_on_death", None)
+            if cb is not None:
+                cb(lambda exc, _rid=rid: self._replica_failed(_rid, exc))
+        self._pending: List[_Ticket] = []
+        self._next_tid = 0
+        self._alive = True
+        self._swap_lock = threading.Lock()  # one rolling swap at a time
+        self._weights_step = -1
+
+        # PR-1-style learned cost model: (kind, bucket) -> EMA ms of
+        # dispatch->delivery wall for one request in that bucket.  The
+        # shed verdict leans on it: no measurement yet = nothing is
+        # provable = admit (measure instead of assume).
+        self._cost: Dict[Tuple[str, int], float] = {}
+        self._metrics = profiler.MetricsRegistry()
+
+        self._server = None
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="mxnet_tpu-fleet-dispatch")
+        self._dispatcher.start()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True,
+            name="mxnet_tpu-fleet-monitor")
+        self._monitor.start()
+        self._set_alive_gauge()
+
+    # -- metrics --------------------------------------------------------
+    def _count(self, name, value=1.0):
+        self._metrics.inc(name, value)
+        profiler.inc_counter(f"fleet.{name}", value)
+
+    def _set_alive_gauge(self):
+        profiler.set_gauge(
+            "fleet.replicas_alive",
+            sum(not s.dead for s in self._replicas.values()))
+
+    # -- client surface -------------------------------------------------
+    def submit(self, inputs, deadline_ms: Optional[float] = None) -> Future:
+        """Route one inference request; the Future resolves to the list
+        of output arrays (or raises :class:`ShedError` /
+        the replica's error)."""
+        return self._accept({"kind": "infer", "inputs": dict(inputs)},
+                            deadline_ms,
+                            units=self._infer_units(inputs))
+
+    def generate(self, prompt, max_new_tokens=32, temperature=None,
+                 eos_id=None, deadline_ms: Optional[float] = None,
+                 seed: Optional[int] = None) -> Future:
+        """Route one generation; the Future resolves to the np.int32
+        generated tokens."""
+        spec = {"kind": "decode",
+                "prompt": np.asarray(prompt, dtype=np.int32),
+                "max_new": int(max_new_tokens), "temperature": temperature,
+                "eos": eos_id, "seed": 0}
+        return self._accept(spec, deadline_ms, units=int(max_new_tokens),
+                            seed=seed)
+
+    @staticmethod
+    def _infer_units(inputs) -> int:
+        for v in inputs.values():
+            shape = np.shape(v)
+            return max(1, int(shape[0]) if len(shape) else 1)
+        return 1
+
+    def _accept(self, spec, deadline_ms, units, seed=None) -> Future:
+        fut: Future = Future()
+        with self._cond:
+            if not self._alive:
+                raise MXNetError("Router is closed")
+            tid = self._next_tid
+            self._next_tid += 1
+            if spec["kind"] == "decode":
+                # the deterministic retry seed: stable across replicas
+                # AND across re-dispatches of this ticket
+                spec["seed"] = int(seed) if seed is not None \
+                    else tid + 1
+            if deadline_ms is None:
+                deadline = (None if self._default_deadline_s is None
+                            else time.monotonic()
+                            + self._default_deadline_s)
+            else:
+                deadline = time.monotonic() + float(deadline_ms) / 1e3
+            t = _Ticket(tid, spec, deadline, max(1, units), fut)
+            self._pending.append(t)
+            profiler.set_gauge("fleet.pending", len(self._pending))
+            self._cond.notify_all()
+        self._count("requests")
+        return fut
+
+    # -- cost model -----------------------------------------------------
+    @staticmethod
+    def _bucket_of(units: int) -> int:
+        b = 1
+        while b < units:
+            b <<= 1
+        return b
+
+    def _est_ms(self, t: _Ticket) -> Optional[float]:
+        return self._cost.get((t.spec["kind"], self._bucket_of(t.units)))
+
+    def _observe_cost(self, t: _Ticket, ms: float):
+        key = (t.spec["kind"], self._bucket_of(t.units))
+        old = self._cost.get(key)
+        self._cost[key] = ms if old is None else 0.5 * old + 0.5 * ms
+
+    def _predicted_wait_ms(self, state: _ReplicaState,
+                           t: _Ticket) -> Optional[float]:
+        """Projected dispatch→done wall on this replica: the measured
+        cost of everything it already owns plus this ticket.  None =
+        no measurement for some bucket → nothing provable."""
+        total = 0.0
+        for o in state.outstanding.values():
+            est = self._est_ms(o)
+            if est is None:
+                return None
+            total += est
+        est = self._est_ms(t)
+        if est is None:
+            return None
+        return total + est
+
+    # -- dispatch -------------------------------------------------------
+    def _eligible(self, t: _Ticket):
+        """(best replica or None, provably_unmeetable) under the lock.
+
+        'Provably unmeetable' requires EVERY live replica's measured
+        projected wait to exceed the remaining deadline — a replica
+        that is merely at depth (can't take the ticket NOW but could
+        meet the deadline once a slot frees) keeps the request
+        admitted, and any unmeasured bucket makes nothing provable
+        (the PR-1 rule: explore/measure instead of assume)."""
+        best, best_wait = None, None
+        provable = t.deadline is not None
+        meetable = False  # some live replica could finish in time
+        remaining_ms = (None if t.deadline is None
+                        else (t.deadline - time.monotonic()) * 1e3)
+        # routing estimate for unmeasured buckets: the mean of the
+        # measured ones (commensurable with real waits — a raw
+        # outstanding COUNT would always undercut millisecond keys and
+        # pile work onto whichever replica holds unmeasured requests)
+        fallback = (sum(self._cost.values()) / len(self._cost)
+                    if self._cost else 1.0)
+        for state in self._replicas.values():
+            if state.dead or state.draining:
+                continue
+            wait = self._predicted_wait_ms(state, t)
+            if wait is None:
+                provable = False  # unmeasured bucket: admit, measure
+                meetable = True
+                wait_key = fallback * (len(state.outstanding) + 1)
+            else:
+                if remaining_ms is not None and wait > remaining_ms:
+                    continue  # this replica provably misses
+                meetable = True
+                wait_key = wait
+            if len(state.outstanding) >= self._replica_depth:
+                continue  # meetable, just not dispatchable yet
+            if best is None or wait_key < best_wait:
+                best, best_wait = state, wait_key
+        return best, (best is None and provable and not meetable
+                      and self._any_live_not_draining())
+
+    def _any_live_not_draining(self) -> bool:
+        return any(not s.dead and not s.draining
+                   for s in self._replicas.values())
+
+    def _dispatch_loop(self):
+        while True:
+            todo = []
+            with self._cond:
+                while self._alive and not self._pending:
+                    self._cond.wait(timeout=0.2)
+                if not self._alive:
+                    return
+                now = time.monotonic()
+                # 1) shed what already missed: serving it late only
+                #    poisons p99 and steals capacity from the living
+                keep = []
+                for t in self._pending:
+                    if t.delivered:  # zombie answered while queued
+                        t.queued = False
+                        continue
+                    if t.deadline is not None and now > t.deadline:
+                        self._shed_locked(
+                            t, "expired",
+                            f"deadline passed while queued "
+                            f"({(now - t.t_submit) * 1e3:.0f} ms in "
+                            f"queue)")
+                    else:
+                        keep.append(t)
+                self._pending = keep
+                # 2) overload: shed oldest-deadline-first down to the
+                #    bound (no-deadline requests shed last, oldest
+                #    submit first among them)
+                while len(self._pending) > self._max_pending:
+                    victim = min(
+                        self._pending,
+                        key=lambda t: (t.deadline
+                                       if t.deadline is not None
+                                       else float("inf"), t.t_submit))
+                    self._pending.remove(victim)
+                    self._shed_locked(
+                        victim, "overload",
+                        f"router queue over {self._max_pending}; "
+                        "oldest-deadline-first shed")
+                # 3) assign FIFO; a head that no replica can take means
+                #    the fleet is at depth — hold the line
+                while self._pending:
+                    t = self._pending[0]
+                    state, unmeetable = self._eligible(t)
+                    if state is None:
+                        if unmeetable:
+                            self._pending.pop(0)
+                            t.queued = False
+                            self._shed_locked(
+                                t, "deadline",
+                                "no replica can finish inside the "
+                                f"deadline (remaining "
+                                f"{(t.deadline - now) * 1e3:.0f} ms, "
+                                "per-bucket cost model)")
+                            continue
+                        break
+                    self._pending.pop(0)
+                    t.queued = False
+                    t.rid = state.handle.rid
+                    t.attempts += 1
+                    t.t_dispatch = time.monotonic()
+                    state.outstanding[t.tid] = t
+                    profiler.set_gauge(
+                        f"fleet.queue_depth.r{t.rid}",
+                        len(state.outstanding))
+                    todo.append((t, state.handle, t.attempts))
+                profiler.set_gauge("fleet.pending", len(self._pending))
+                if not todo and self._pending:
+                    # head can't be placed (fleet at depth / draining):
+                    # wait for a completion to free a slot instead of
+                    # spinning the shed/assign scan at 100% CPU
+                    self._cond.wait(timeout=0.05)
+            for t, handle, attempt in todo:
+                try:
+                    rfut = handle.submit(t.spec)
+                except BaseException as exc:  # noqa: BLE001
+                    self._replica_failed(handle.rid, exc)
+                    continue
+                rfut.add_done_callback(
+                    lambda f, _t=t, _a=attempt, _r=handle.rid:
+                    self._on_done(_t, f, _a, _r))
+
+    def _shed_locked(self, t: _Ticket, reason: str, detail: str):
+        t.delivered = True
+        t.queued = False
+        self._count("shed")
+        self._count(f"shed_{reason}")
+        exc = ShedError(f"request shed ({reason}): {detail}",
+                        reason=reason)
+        if t.future.set_running_or_notify_cancel():
+            t.future.set_exception(exc)
+
+    # -- completion -----------------------------------------------------
+    def _on_done(self, t: _Ticket, rfut: Future, attempt: int,
+                 rid_disp: int):
+        """A replica's future resolved for dispatch #``attempt`` of
+        this ticket.  Exactly-once lives here: the ``delivered`` latch
+        retires the ticket on FIRST delivery; a late/stale completion
+        (the ticket was already retried elsewhere, or already answered)
+        is dropped, never double-delivered and never double-retried."""
+        exc = rfut.exception()
+        retry = False
+        with self._cond:
+            current = (t.attempts == attempt)
+            if current:
+                state = self._replicas.get(rid_disp)
+                if state is not None:
+                    state.outstanding.pop(t.tid, None)
+                    if not state.dead:
+                        profiler.set_gauge(
+                            f"fleet.queue_depth.r{rid_disp}",
+                            len(state.outstanding))
+            if t.delivered:
+                # late answer from a dispatch we already gave up on:
+                # the ticket is retired — exactly-once means DROP it
+                self._count("duplicates")
+                self._cond.notify_all()
+                return
+            if exc is None:
+                # even a STALE success delivers (the convicted replica
+                # answered after all — first answer wins; the live
+                # retry's answer will hit the latch above).  If
+                # _replica_failed already requeued the ticket, pull it
+                # back out: a delivered ticket left in _pending would
+                # be re-dispatched (wasted work) and later shed/close
+                # passes would trip on its finished future.
+                t.delivered = True
+                if t.queued:
+                    t.queued = False
+                    try:
+                        self._pending.remove(t)
+                    except ValueError:
+                        pass
+                if current:
+                    self._observe_cost(
+                        t, (time.monotonic() - t.t_dispatch) * 1e3)
+            elif not current or t.queued:
+                # stale failure, or _replica_failed already requeued
+                # this ticket: the live dispatch owns the outcome
+                self._cond.notify_all()
+                return
+            elif self._is_replica_failure(exc):
+                if t.attempts <= self._retry_budget:
+                    retry = True
+                    t.queued = True
+                    self._pending.insert(0, t)  # oldest first
+                    self._count("retries")
+                else:
+                    t.delivered = True
+            else:
+                t.delivered = True  # the request itself is bad
+            self._cond.notify_all()
+        if retry:
+            return
+        lat_ms = (time.monotonic() - t.t_submit) * 1e3
+        self._metrics.observe("latency_ms", lat_ms)
+        profiler.observe("fleet.latency_ms", lat_ms)
+        if t.future.set_running_or_notify_cancel():
+            if exc is None:
+                self._count("responses")
+                res = rfut.result()
+                # handle contract: a LIST of output arrays (decode =
+                # one token tensor) — unwrap for generate() callers
+                if t.spec["kind"] == "decode" \
+                        and isinstance(res, (list, tuple)):
+                    res = res[0]
+                t.future.set_result(res)
+            else:
+                self._count("failures")
+                t.future.set_exception(exc)
+
+    @staticmethod
+    def _is_replica_failure(exc: BaseException) -> bool:
+        """Failures that indict the REPLICA (retry elsewhere), vs the
+        request (fail the client: validation, bad shapes...)."""
+        from .serving import EngineClosedError
+
+        if isinstance(exc, (EngineClosedError, ConnectionError)):
+            return True
+        if isinstance(exc, MXNetError):
+            msg = str(exc)
+            return any(tok in msg for tok in
+                       ("connection", "died", "closed", "reset",
+                        "peer", "draining"))
+        return isinstance(exc, OSError)
+
+    # -- health ---------------------------------------------------------
+    def _monitor_loop(self):
+        interval = min(heartbeat_interval(), self._dead_timeout / 4.0)
+        while True:
+            with self._lock:
+                if not self._alive:
+                    return
+                rids = [r for r, s in self._replicas.items()
+                        if not s.dead]
+            if self._fleet_dir:
+                for rid in stale_ids(self._fleet_dir, rids,
+                                     timeout=self._dead_timeout):
+                    self._replica_failed(
+                        rid, MXNetError("heartbeat went stale"))
+            for rid in rids:
+                dead = getattr(self._replicas[rid].handle,
+                               "transport_dead", None)
+                if dead is not None:
+                    self._replica_failed(rid, dead)
+            time.sleep(max(0.02, interval))
+
+    def _replica_failed(self, rid: int, exc: BaseException):
+        """Convict one replica: mark dead, re-queue its unretired
+        tickets on the survivors (the transparent-retry path)."""
+        with self._cond:
+            if not self._alive:
+                return  # teardown closes sockets; not a conviction
+            state = self._replicas.get(rid)
+            if state is None or state.dead:
+                return
+            state.dead = True
+            orphans = [t for t in state.outstanding.values()
+                       if not t.delivered and not t.queued]
+            state.outstanding.clear()
+            self._count("replica_deaths")
+            _log.warning(
+                "[fleet] replica %d convicted dead (%s); retrying %d "
+                "in-flight request(s) on the survivors", rid, exc,
+                len(orphans))
+            for t in orphans:
+                if t.attempts <= self._retry_budget:
+                    t.queued = True
+                    self._pending.insert(0, t)
+                    self._count("retries")
+                else:
+                    t.delivered = True
+                    if t.future.set_running_or_notify_cancel():
+                        t.future.set_exception(MXNetError(
+                            f"request failed on {t.attempts} replica(s); "
+                            f"retry budget {self._retry_budget} "
+                            f"exhausted (last: {exc})"))
+            self._cond.notify_all()
+        profiler.del_gauge(f"fleet.queue_depth.r{rid}")
+        self._set_alive_gauge()
+        try:
+            state.handle.close()
+        except Exception:  # noqa: BLE001 — already convicted
+            pass
+
+    def alive_replicas(self) -> List[int]:
+        with self._lock:
+            return sorted(r for r, s in self._replicas.items()
+                          if not s.dead)
+
+    # -- rolling weight swap --------------------------------------------
+    def swap_weights(self, ckpt_dir: str,
+                     drain_timeout: Optional[float] = None) -> Dict:
+        """Zero-downtime rolling update: one replica at a time —
+        stop routing to it, wait for its in-flight tickets to deliver,
+        ``swap`` (drain → load committed+checksum-verified manifest →
+        warmup) on the replica, re-admit — while the rest of the fleet
+        keeps serving.  No request is dropped: traffic redistributes
+        around the draining replica, and a swap failure resumes the
+        replica on its OLD weights and aborts the roll (replicas
+        already swapped stay swapped — re-run to converge).
+        """
+        from .checkpoint import load_latest_params
+
+        drain_timeout = (self._swap_drain_timeout
+                         if drain_timeout is None else float(drain_timeout))
+        # verify ONCE router-side before touching any replica: a bad
+        # checkpoint must not take even one replica out of rotation
+        _params, step, path = load_latest_params(ckpt_dir)
+        del _params
+        with self._swap_lock:
+            t0 = time.monotonic()
+            reports: Dict[int, Dict] = {}
+            for rid in self.alive_replicas():
+                with self._cond:
+                    state = self._replicas.get(rid)
+                    if state is None or state.dead:
+                        continue
+                    state.draining = True
+                try:
+                    deadline = time.monotonic() + drain_timeout
+                    while True:
+                        with self._lock:
+                            left = len(state.outstanding)
+                        if left == 0:
+                            break
+                        if time.monotonic() > deadline:
+                            raise MXNetError(
+                                f"swap aborted: replica {rid} still has "
+                                f"{left} ticket(s) in flight after "
+                                f"{drain_timeout:.0f}s")
+                        time.sleep(0.005)
+                    reports[rid] = state.handle.swap(
+                        path, drain_timeout=drain_timeout)
+                    state.swaps += 1
+                finally:
+                    with self._cond:
+                        state.draining = False
+                        self._cond.notify_all()
+            self._weights_step = step
+            self._count("swaps")
+            profiler.set_gauge("fleet.weights_step", float(step))
+            return {"step": step, "path": path,
+                    "replicas": reports,
+                    "total_ms": (time.monotonic() - t0) * 1e3}
+
+    # -- stats ----------------------------------------------------------
+    def stats(self) -> Dict:
+        summ = self._metrics.summary()
+        c = summ["counters"]
+        out = {k: int(c.get(k, 0)) for k in
+               ("requests", "responses", "failures", "shed", "retries",
+                "duplicates", "replica_deaths", "swaps")}
+        lat = summ["histograms"].get("latency_ms")
+        out["p50_ms"] = lat["p50"] if lat else None
+        out["p90_ms"] = lat["p90"] if lat else None
+        out["p99_ms"] = lat["p99"] if lat else None
+        out["requests_per_s"] = summ["rates"].get("requests", 0.0)
+        out["shed_rate"] = (out["shed"] / out["requests"]
+                            if out["requests"] else 0.0)
+        with self._lock:
+            out["pending"] = len(self._pending)
+            out["replicas"] = {
+                rid: {"dead": s.dead, "draining": s.draining,
+                      "outstanding": len(s.outstanding),
+                      "swaps": s.swaps}
+                for rid, s in self._replicas.items()}
+        out["alive"] = self.alive_replicas()
+        out["weights_step"] = self._weights_step
+        out["cost_model_ms"] = {f"{k}:{b}": round(v, 3)
+                                for (k, b), v in sorted(self._cost.items())}
+        return out
+
+    def reset_stats(self):
+        """Per-sweep-point percentiles for the bench (the DecodeEngine
+        convention)."""
+        self._metrics.reset()
+
+    # -- client wire ----------------------------------------------------
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Expose the router on the fleet wire; returns the bound port.
+        Clients speak :class:`FleetClient`."""
+        router = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                wlock = threading.Lock()
+                try:
+                    while True:
+                        req = wire.recv_frame(self.request)
+                        router._client_dispatch(req, self.request, wlock)
+                except (ConnectionError, EOFError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="mxnet_tpu-fleet-router").start()
+        return port
+
+    def _client_dispatch(self, buf: memoryview, sock, wlock):
+        op = buf[0]
+        (rid,) = wire.U64.unpack_from(buf, 1)
+
+        def send(fop, status, payload: bytes):
+            frame = bytes([fop]) + wire.U64.pack(rid) \
+                + bytes([status]) + payload
+            try:
+                with wlock:
+                    wire.send_frame(sock, frame)
+            except OSError:
+                pass  # client went away; nothing to deliver to
+
+        if op == _F_SUBMIT:
+            try:
+                # client SUBMIT carries a deadline budget before the
+                # request spec (0 = none → the router default applies)
+                (deadline_us,) = wire.U64.unpack_from(buf, 9)
+                deadline_ms = deadline_us / 1e3 if deadline_us else None
+                spec = _unpack_spec(buf, 17)
+                if spec["kind"] == "infer":
+                    fut = self.submit(spec["inputs"],
+                                      deadline_ms=deadline_ms)
+                else:
+                    # wire seed 0 = router-assigned (the deterministic
+                    # ticket seed); explicit seeds pass through
+                    fut = self.generate(
+                        spec["prompt"], spec["max_new"],
+                        temperature=spec["temperature"],
+                        eos_id=spec["eos"],
+                        deadline_ms=deadline_ms,
+                        seed=spec["seed"] or None)
+            except ShedError as exc:
+                send(_F_RESULT, _ST_SHED, f"{exc.reason}: {exc}".encode())
+                return
+            except BaseException as exc:  # noqa: BLE001 — to the wire
+                send(_F_RESULT, _ST_ERR,
+                     f"{type(exc).__name__}: {exc}".encode())
+                return
+
+            def done(f):
+                exc = f.exception()
+                if exc is None:
+                    send(_F_RESULT, _ST_OK, _pack_result(f.result()))
+                elif isinstance(exc, ShedError):
+                    send(_F_RESULT, _ST_SHED,
+                         f"{exc.reason}: {exc}".encode())
+                else:
+                    send(_F_RESULT, _ST_ERR,
+                         f"{type(exc).__name__}: {exc}".encode())
+
+            fut.add_done_callback(done)
+            return
+        if op == _F_CTRL:
+            # control ops run OFF the connection's read thread: a
+            # rolling swap takes minutes of drain+warmup and must not
+            # stall this client's subsequent submits (the ReplicaServer
+            # ctrl-thread rule)
+            def ctrl():
+                try:
+                    spec, _ = wire.unpack_signed_json(
+                        self._secret, buf, 9, "fleet control frame")
+                    if spec.get("op") == "stats":
+                        out = self.stats()
+                    elif spec.get("op") == "swap":
+                        out = self.swap_weights(spec["ckpt_dir"])
+                    else:
+                        raise MXNetError(
+                            f"unknown router control op "
+                            f"{spec.get('op')!r}")
+                    send(_F_CTRL_RESULT, _ST_OK, json.dumps(out).encode())
+                except BaseException as exc:  # noqa: BLE001
+                    send(_F_CTRL_RESULT, _ST_ERR,
+                         f"{type(exc).__name__}: {exc}".encode())
+
+            threading.Thread(target=ctrl, daemon=True,
+                             name="mxnet_tpu-fleet-router-ctrl").start()
+            return
+        send(_F_RESULT, _ST_ERR, f"unknown fleet op {op}".encode())
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self, stop_replicas: bool = False):
+        with self._cond:
+            if not self._alive:
+                return
+            self._alive = False
+            pending, self._pending = self._pending, []
+            self._cond.notify_all()
+        for t in pending:
+            if not t.delivered \
+                    and t.future.set_running_or_notify_cancel():
+                t.future.set_exception(MXNetError("Router closed"))
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        for state in self._replicas.values():
+            try:
+                if stop_replicas and not state.dead:
+                    stop = getattr(state.handle, "stop", None)
+                    if stop is not None:
+                        stop()
+                state.handle.close()
+            except Exception:  # noqa: BLE001 — teardown
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class FleetClient:
+    """Client of a served :class:`Router` (the ``ps.py`` wire: length-
+    prefixed frames, tensors never pickled, control payloads HMAC'd).
+    Any number of requests may be in flight; responses match by id."""
+
+    def __init__(self, host: str, port: int, secret: bytes = b"",
+                 timeout: float = 30.0):
+        t0 = time.monotonic()
+        while True:
+            try:
+                sock = socket.create_connection((host, port), timeout=10)
+                break
+            except OSError:
+                if time.monotonic() - t0 > timeout:
+                    raise MXNetError(
+                        f"cannot reach fleet router at {host}:{port}")
+                time.sleep(0.1)
+        sock.settimeout(None)
+        self._secret = secret
+        self._dx = _Duplex(sock, "client")
+        self._dx.start()
+
+    def submit(self, inputs: Dict[str, Any],
+               deadline_ms: Optional[float] = None) -> Future:
+        spec = {"kind": "infer", "inputs": inputs}
+        return self._begin_submit(spec, deadline_ms)
+
+    def generate(self, prompt, max_new_tokens=32, temperature=None,
+                 eos_id=None, deadline_ms: Optional[float] = None) -> Future:
+        spec = {"kind": "decode", "prompt": prompt,
+                "max_new": max_new_tokens, "temperature": temperature,
+                "eos": eos_id, "seed": 0}
+        fut = self._begin_submit(spec, deadline_ms)
+        # decode result is ONE token tensor, not a list
+        out: Future = Future()
+
+        def unwrap(f):
+            exc = f.exception()
+            if out.set_running_or_notify_cancel():
+                if exc is not None:
+                    out.set_exception(exc)
+                else:
+                    out.set_result(f.result()[0])
+
+        fut.add_done_callback(unwrap)
+        return out
+
+    def _begin_submit(self, spec, deadline_ms) -> Future:
+        deadline_us = 0 if deadline_ms is None \
+            else max(1, int(float(deadline_ms) * 1e3))
+        body = wire.U64.pack(deadline_us) + _pack_spec(spec)
+        return self._dx.begin(_F_SUBMIT, body, _parse_submit_response)
+
+    def stats(self) -> Dict:
+        return self._ctrl({"op": "stats"})
+
+    def swap_weights(self, ckpt_dir: str) -> Dict:
+        return self._ctrl({"op": "swap", "ckpt_dir": ckpt_dir},
+                          timeout=3600.0)
+
+    def _ctrl(self, obj: Dict, timeout: float = 60.0) -> Dict:
+        def parse(status, payload):
+            if status != _ST_OK:
+                return MXNetError(bytes(payload).decode(errors="replace"))
+            return json.loads(bytes(payload).decode())
+
+        body = wire.pack_signed_json(self._secret, obj)
+        return self._dx.begin(_F_CTRL, body, parse).result(timeout)
+
+    def close(self):
+        self._dx.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# local fleet launcher (bench + chaos drill)
+# ---------------------------------------------------------------------------
+
+
+def launch_local_fleet(num_replicas: Optional[int], fleet_dir: str,
+                       builder: str, builder_kwargs: Optional[Dict] = None,
+                       secret: bytes = b"fleet-local", **router_kw):
+    """Spawn N replica processes on this host, connect handles, return
+    ``(router, procs)``.  The chaos drill's entry point: ``kill -9``
+    any of ``procs`` and the router carries on."""
+    n = int(fleet_env("MXNET_FLEET_REPLICAS")
+            if num_replicas is None else num_replicas)
+    os.makedirs(fleet_dir, exist_ok=True)
+    write_secret(fleet_dir, secret)
+    procs = [spawn_replica(rid, fleet_dir, builder, builder_kwargs)
+             for rid in range(n)]
+    handles = []
+    try:
+        for rid in range(n):
+            host, port = read_endpoint(fleet_dir, rid)
+            handles.append(ReplicaClient(rid, host, port, secret=secret))
+    except BaseException:
+        for p in procs:
+            p.kill()
+        raise
+    router = Router(handles, fleet_dir=fleet_dir, secret=secret,
+                    **router_kw)
+    return router, procs
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m mxnet_tpu.fleet '<replica spec json>'",
+              file=sys.stderr)
+        return 2
+    return _replica_main(json.loads(argv[0]))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1013,35 +1013,20 @@ class DistKVStore(TPUKVStore):
         """Heartbeat-staleness scan → the sorted list of dead ranks.
 
         ``timeout`` defaults to ``MXNET_DEAD_RANK_TIMEOUT``.  Scans the
-        active membership (elastic) or the launch world.  A rank is
-        dead when its heartbeat file is missing or older than the
-        threshold; mtimes in the FUTURE (writer clock ahead of ours on
-        a shared filesystem) count as fresh — clock skew must never
-        accuse a live rank.  Our own rank is alive by construction."""
-        import os
-        import time
+        active membership (elastic) or the launch world with the shared
+        :func:`elastic.stale_ids` scan (missing-or-stale = dead; FUTURE
+        mtimes count as fresh so clock skew can never accuse a live
+        rank).  Our own rank is alive by construction."""
+        from .elastic import stale_ids
 
         if not self._hb_dir:
             return []
-        if timeout is None:
-            timeout = dead_rank_timeout()
         if ranks is None:
             ranks = self._active if self._elastic \
                 else range(self.num_workers)
-        now = time.time()
-        dead = []
-        for r in ranks:
-            if r == self.rank:
-                continue
-            path = os.path.join(self._hb_dir, f"hb_{r}")
-            try:
-                age = now - os.path.getmtime(path)
-            except OSError:
-                dead.append(r)  # never wrote a heartbeat
-                continue
-            if max(age, 0.0) > timeout:
-                dead.append(r)
-        return sorted(dead)
+        return stale_ids(self._hb_dir,
+                         [r for r in ranks if r != self.rank],
+                         timeout=timeout)
 
     def check_peers(self):
         """The failure verdict as a poll: raise DeadRankError when any
@@ -1194,12 +1179,12 @@ class DistKVStore(TPUKVStore):
         """File-heartbeat liveness (the ps-lite heartbeat role,
         kvstore_dist.h:151-160): each worker touches
         ``$MXNET_KVSTORE_HEARTBEAT_DIR/hb_<rank>`` every interval; peers
-        whose file goes stale count as dead."""
+        whose file goes stale count as dead.  The writer is the shared
+        :class:`elastic.HeartbeatWriter` (the serving fleet's replica
+        liveness uses the same machinery)."""
         import os
-        import threading
-        import time
 
-        from .chaos import get_chaos
+        from .elastic import HeartbeatWriter
 
         self._hb_dir = os.environ.get("MXNET_KVSTORE_HEARTBEAT_DIR")
         # cadence from the unified MXNET_HEARTBEAT_INTERVAL (validated
@@ -1207,27 +1192,9 @@ class DistKVStore(TPUKVStore):
         # still works as a fallback — see elastic.heartbeat_interval
         if not self._hb_dir:
             return
-        os.makedirs(self._hb_dir, exist_ok=True)
-        path = os.path.join(self._hb_dir, f"hb_{self.rank}")
-        rank = self.rank
-
-        def beat():
-            while True:
-                try:
-                    with open(path, "w") as f:
-                        f.write(str(time.time()))
-                except OSError:
-                    pass
-                # chaos: the delayed-heartbeat fault — go silent long
-                # enough for peers to (wrongly or rightly) convict us
-                stall = get_chaos().heartbeat_stall_s(rank=rank)
-                if stall:
-                    time.sleep(stall)
-                time.sleep(self._hb_interval)
-
-        t = threading.Thread(target=beat, daemon=True,
-                             name="mxnet_tpu-kvstore-heartbeat")
-        t.start()
+        HeartbeatWriter(self._hb_dir, self.rank,
+                        interval=self._hb_interval,
+                        chaos_ident=self.rank)
 
     def barrier(self):
         """All-process rendezvous (reference: kvstore_dist.h Barrier →

@@ -108,6 +108,47 @@ class Predictor:
         self._inputs = {}
         self._outputs = None
 
+    def set_params(self, params):
+        """Replace the frozen weights/aux IN PLACE (live weight swap).
+
+        ``params`` is a merged name→array dict (aux states recognized
+        by name, extra names ignored); every existing weight must be
+        present with its bound shape — a truncated or mismatched
+        checkpoint refuses loudly instead of serving half-new weights.
+        Holders of an earlier ``forward_closure`` keep the OLD weights
+        (the closure captured them); re-pull the closure after a swap —
+        ``serving.InferenceEngine.swap_params`` does exactly that and
+        recompiles its buckets."""
+        import jax
+
+        dev = self._ctx.jax_device()
+
+        def install(store):
+            new = {}
+            for name, old in store.items():
+                v = params.get(name)
+                if v is None:
+                    raise MXNetError(
+                        f"set_params: missing parameter {name!r}")
+                arr = np.asarray(
+                    v.asnumpy() if hasattr(v, "asnumpy") else v)
+                if tuple(arr.shape) != tuple(np.shape(old)):
+                    raise MXNetError(
+                        f"set_params: param {name!r} shape {arr.shape} "
+                        f"!= bound {tuple(np.shape(old))}")
+                new[name] = jax.device_put(
+                    arr.astype(old.dtype, copy=False), dev)
+            return new
+
+        new_weights = install(self._weights)
+        new_aux = install(self._aux)
+        # rebind (not mutate): closures traced from the old dicts stay
+        # self-consistent instead of observing a half-swapped store
+        self._weights = new_weights
+        self._aux = new_aux
+        self._fn = jax.jit(self.forward_closure())
+        self._outputs = None
+
     def forward_closure(self):
         """The pure inference function ``{input_name: array} -> outputs``
         with the weights/aux closed over.

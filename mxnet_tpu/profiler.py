@@ -257,6 +257,13 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def del_gauge(self, name):
+        """Retire a gauge from the registry (e.g. a per-replica queue
+        depth whose replica died): exporters stop advertising it
+        instead of freezing its last value forever."""
+        with self._lock:
+            self._gauges.pop(name, None)
+
     def inc_gauge(self, name, delta, gen=None):
         """Adjust a gauge by ``delta``; returns the generation the
         delta was applied under (or None if dropped).  Delta-tracked
@@ -345,6 +352,12 @@ def inc_counter(name, value=1.0):
 def set_gauge(name, value):
     """Set a named gauge to an absolute value (e.g. queue depth)."""
     _metrics.set_gauge(name, value)
+
+
+def del_gauge(name):
+    """Retire a named gauge (a dead replica's queue depth must drop
+    out of the exposition, not freeze at its last value)."""
+    _metrics.del_gauge(name)
 
 
 def inc_gauge(name, delta, gen=None):

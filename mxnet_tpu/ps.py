@@ -33,8 +33,6 @@ initialized JAX distributed runtime (``broadcast_one_to_all`` /
 
 from __future__ import annotations
 
-import hashlib
-import hmac
 import logging
 import os
 import pickle
@@ -49,37 +47,33 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import profiler as _prof
+from . import wire
 from .base import MXNetError
 
 __all__ = ["ParameterServer", "PSClient", "ShardedPSClient",
            "server_of", "split_sizes", "bigarray_bound"]
 
-_U32 = struct.Struct("!I")
-_U64 = struct.Struct("!Q")
-_I64 = struct.Struct("!q")
+# Frame/tensor encoding + HMAC live in mxnet_tpu.wire, SHARED with the
+# serving fleet's router protocol (fleet.py) so the two cannot drift.
+# The private-name aliases are the API this module's callers grew up
+# with (kept: tests and tools poke the wire through them).
+_U32 = wire.U32
+_U64 = wire.U64
+_I64 = wire.I64
+_pack_key = wire.pack_key
+_unpack_key = wire.unpack_key
+_pack_tensor = wire.pack_tensor
+_unpack_tensor = wire.unpack_tensor
+_wire_dtype = wire._wire_dtype
+_send_frame = wire.send_frame
+_recv_frame = wire.recv_frame
+_recv_exact = wire.recv_exact
+_err_body = wire.err_body
+_is_transient = wire.is_transient
 
 # ops
 (_INIT, _PUSH, _PULL, _SET_OPT, _NUM_APPLIED, _STOP, _PUSH_SYNC,
  _PUSH_MULTI, _PULL_MULTI, _REMESH) = range(1, 11)
-
-# errno values classified as TRANSIENT: a reconnect may heal them
-_TRANSIENT_ERRNOS = frozenset(
-    getattr(__import__("errno"), n) for n in
-    ("ECONNRESET", "EPIPE", "ECONNABORTED", "ECONNREFUSED", "ETIMEDOUT")
-    if hasattr(__import__("errno"), n))
-
-
-def _is_transient(exc: BaseException) -> bool:
-    """Socket failures a bounded reconnect may heal (ECONNRESET/EPIPE
-    mid-frame, a shard restarting) — vs. protocol errors and response-
-    pipeline corruption, which must stay fatal."""
-    if isinstance(exc, ConnectionError):  # reset/refused/aborted/pipe
-        return True
-    if isinstance(exc, socket.timeout):
-        return False  # 630s of silence is a hang, not a blip
-    if isinstance(exc, OSError):
-        return exc.errno in _TRANSIENT_ERRNOS
-    return False
 
 
 def reconnect_budget() -> int:
@@ -117,96 +111,8 @@ def split_sizes(size: int, num_servers: int) -> List[int]:
 
 
 # ---------------------------------------------------------------------------
-# wire format: u32 frame length | u8 op/status | typed fields.
-# Tensors are dtype/shape/raw-bytes — never pickled.
+# request bodies (op-specific; the framing itself lives in wire.py)
 # ---------------------------------------------------------------------------
-
-
-def _pack_key(key) -> bytes:
-    if isinstance(key, (int, np.integer)):
-        return b"\x00" + _I64.pack(int(key))
-    kb = str(key).encode()
-    if len(kb) > 0xFFFF:
-        raise MXNetError("key too long")
-    return b"\x01" + struct.pack("!H", len(kb)) + kb
-
-
-def _unpack_key(buf: memoryview, off: int):
-    kind = buf[off]
-    off += 1
-    if kind == 0:
-        (k,) = _I64.unpack_from(buf, off)
-        return int(k), off + 8
-    (n,) = struct.unpack_from("!H", buf, off)
-    off += 2
-    return bytes(buf[off:off + n]).decode(), off + n
-
-
-def _pack_tensor(arr: np.ndarray) -> bytes:
-    arr = np.ascontiguousarray(arr)
-    # '<f4'-style typestrings are unambiguous and endian-tagged, but
-    # extension float dtypes (ml_dtypes bfloat16 — the bf16 gradient
-    # wire) stringify as an opaque '<V2'; ship their registered NAME
-    # ('bfloat16') instead, which np.dtype() resolves on the far side
-    ds = arr.dtype.str
-    dt = (arr.dtype.name if ds.lstrip("<>|=")[0] == "V" else ds).encode()
-    if arr.ndim > 0xFF or len(dt) > 0xFF:
-        raise MXNetError("tensor rank/dtype out of protocol range")
-    head = struct.pack("!B", len(dt)) + dt + struct.pack("!B", arr.ndim)
-    head += struct.pack(f"!{arr.ndim}I", *arr.shape) if arr.ndim else b""
-    return head + arr.tobytes()
-
-
-def _wire_dtype(token: str) -> np.dtype:
-    try:
-        return np.dtype(token)
-    except TypeError:
-        # extension dtype by name ('bfloat16'): registered by ml_dtypes
-        import ml_dtypes  # noqa: F401 — import registers the dtypes
-
-        return np.dtype(token)
-
-
-def _unpack_tensor(buf: memoryview, off: int) -> Tuple[np.ndarray, int]:
-    dlen = buf[off]
-    off += 1
-    dt = _wire_dtype(bytes(buf[off:off + dlen]).decode())
-    off += dlen
-    ndim = buf[off]
-    off += 1
-    shape = struct.unpack_from(f"!{ndim}I", buf, off) if ndim else ()
-    off += 4 * ndim
-    n = int(np.prod(shape)) if shape else 1
-    nbytes = n * dt.itemsize
-    arr = np.frombuffer(buf[off:off + nbytes], dtype=dt).reshape(shape)
-    return arr, off + nbytes
-
-
-def _send_frame(sock: socket.socket, body: bytes) -> None:
-    sock.sendall(_U32.pack(len(body)) + body)
-
-
-def _recv_frame(sock: socket.socket) -> memoryview:
-    hdr = _recv_exact(sock, _U32.size)
-    (n,) = _U32.unpack(hdr)
-    return memoryview(_recv_exact(sock, n))
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    got = 0
-    while got < n:
-        chunk = sock.recv(min(n - got, 1 << 20))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        chunks.append(chunk)
-        got += len(chunk)
-    return b"".join(chunks)
-
-
-def _err_body(msg: str) -> bytes:
-    mb = msg.encode()[:0xFFFF]
-    return b"\x01" + struct.pack("!H", len(mb)) + mb
 
 
 def _body_init(key, value) -> bytes:
@@ -377,13 +283,8 @@ class ParameterServer:
                 blob = bytes(buf[off:off + blen])
                 off += blen
                 mac = bytes(buf[off:off + 32])
-                if not self._secret:
-                    raise MXNetError(
-                        "server has no HMAC secret — remesh refused "
-                        "(membership changes must be authenticated)")
-                want = hmac.new(self._secret, blob, hashlib.sha256).digest()
-                if not hmac.compare_digest(mac, want):
-                    raise MXNetError("remesh frame failed HMAC verification")
+                wire.verify(self._secret, blob, mac,
+                            "remesh (membership change)")
                 import json as _json
 
                 spec = _json.loads(blob.decode())
@@ -402,19 +303,9 @@ class ParameterServer:
                 blob = bytes(buf[off:off + blen])
                 off += blen
                 mac = bytes(buf[off:off + 32])
-                if not self._secret:
-                    # an empty key would make the MAC computable by
-                    # anyone who can reach the port — the exact RCE
-                    # surface this protocol exists to close
-                    raise MXNetError(
-                        "server has no HMAC secret — set_optimizer "
-                        "refused (construct ParameterServer with the "
-                        "launcher-distributed secret)")
-                want = hmac.new(self._secret, blob, hashlib.sha256).digest()
-                if not hmac.compare_digest(mac, want):
-                    raise MXNetError(
-                        "optimizer blob failed HMAC verification — "
-                        "refusing to unpickle")
+                # refused-before-unpickle: see wire.verify
+                wire.verify(self._secret, blob, mac,
+                            "set_optimizer (pickled payload)")
                 from . import optimizer as opt
 
                 with self._cond:
@@ -894,8 +785,8 @@ class PSClient:
 
     def set_optimizer(self, optimizer):
         blob = pickle.dumps(optimizer)
-        mac = hmac.new(self._secret, blob, hashlib.sha256).digest()
-        self._call(bytes([_SET_OPT]) + _U32.pack(len(blob)) + blob + mac)
+        self._call(bytes([_SET_OPT]) + _U32.pack(len(blob)) + blob
+                   + wire.sign(self._secret, blob))
 
     def num_applied(self, key) -> int:
         resp = self._call(bytes([_NUM_APPLIED]) + _pack_key(key))
@@ -952,7 +843,7 @@ class ShardedPSClient:
                             "reset": bool(reset)}).encode()
         self._fan_out([
             (cl, bytes([_REMESH]) + _U32.pack(len(blob)) + blob
-             + hmac.new(cl._secret, blob, hashlib.sha256).digest(), None)
+             + wire.sign(cl._secret, blob), None)
             for cl in self.clients])
         self.set_epoch(epoch)
 
@@ -1169,7 +1060,7 @@ class ShardedPSClient:
         blob = pickle.dumps(optimizer)
         self._fan_out([
             (cl, bytes([_SET_OPT]) + _U32.pack(len(blob)) + blob
-             + hmac.new(cl._secret, blob, hashlib.sha256).digest(), None)
+             + wire.sign(cl._secret, blob), None)
             for cl in self.clients])
 
     def num_applied(self, key, size: Optional[int] = None) -> int:

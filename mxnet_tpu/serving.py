@@ -44,7 +44,8 @@ import numpy as np
 from .base import MXNetError, get_env
 from . import profiler
 
-__all__ = ["InferenceEngine", "DecodeEngine", "EngineClosedError"]
+__all__ = ["InferenceEngine", "DecodeEngine", "EngineClosedError",
+           "ReplicaHarness"]
 
 _DEFAULT_BUCKETS = (1, 8, 32, 128)
 
@@ -93,6 +94,21 @@ class _PredictorModel:
                          donate_argnums=(0,) if donate else ())
         return jitted.lower(specs).compile()
 
+    def set_params(self, params):
+        """Live weight swap: install new weights on the Predictor and
+        re-pull the forward closure (compiled executables baked the OLD
+        weights in as constants — the caller must recompile)."""
+        self._pred.set_params(params)
+        self._forward = self._pred.forward_closure()
+
+    def get_params(self):
+        """Host-side snapshot of the served weights (merged weights +
+        aux) — the rollback anchor for a failed swap."""
+        import numpy as _np
+
+        return {n: _np.asarray(v) for n, v in
+                {**self._pred._weights, **self._pred._aux}.items()}
+
 
 class _ExportedModel:
     """Adapter: a ``predictor.export_model`` artifact.
@@ -120,6 +136,15 @@ class _ExportedModel:
         import jax
 
         self.device = jax.devices()[0]
+
+    def set_params(self, params):
+        raise MXNetError(
+            "exported artifacts are weight-frozen StableHLO — no live "
+            "swap; re-export and restart the replica instead")
+
+    def get_params(self):
+        raise MXNetError("exported artifacts embed their weights; "
+                         "there is nothing to snapshot")
 
     def compile(self, bucket: int, donate: bool):
         if bucket != self.export_batch:
@@ -229,6 +254,12 @@ class InferenceEngine:
         self._bucket_ms: Dict[int, float] = {}
         self._alive = True
         self._accepting = True
+        self._reject = None  # drain(): submit's refusal message
+        # every accepted-but-unresolved request's future: the
+        # inflight() snapshot the fleet router reads — without it the
+        # only way to know what died with an engine is to OWN its
+        # futures (see ReplicaHarness)
+        self._owned: set = set()
         # orders submit's (check, put) against close's (clear, sentinel):
         # an accepted request always lands BEFORE the sentinel, so the
         # drain path serves it instead of stranding its future
@@ -265,7 +296,7 @@ class InferenceEngine:
         model has exactly one input.
         """
         if not self._accepting:
-            raise MXNetError("InferenceEngine is closed")
+            raise MXNetError(self._reject or "InferenceEngine is closed")
         names = self._model.input_names
         if not isinstance(inputs, dict):
             if len(names) != 1:
@@ -310,8 +341,9 @@ class InferenceEngine:
         # serialize every other submitter (or close()) behind it
         while True:
             with self._accept_lock:
-                if not self._accepting:  # close() raced us
-                    raise MXNetError("InferenceEngine is closed")
+                if not self._accepting:  # close()/drain() raced us
+                    raise MXNetError(
+                        self._reject or "InferenceEngine is closed")
                 try:
                     self._queue.put_nowait(req)
                     break
@@ -321,7 +353,72 @@ class InferenceEngine:
         # count only after the put: a request rejected by the race
         # above was never accepted and must not skew requests-vs-images
         self._count("requests")
+        # membership-first then callback: if the future is ALREADY done
+        # the callback runs inline and discards what we just added
+        with self._lock:
+            self._owned.add(fut)
+        fut.add_done_callback(self._disown)
         return fut
+
+    def _disown(self, fut):
+        with self._lock:
+            self._owned.discard(fut)
+
+    def inflight(self) -> int:
+        """Accepted-but-unresolved request count: queued, coalescing,
+        or dispatched — everything that would die with this engine.
+        Poisoned futures (a dead loop, close()) leave the count the
+        moment their exception is set, so after a drain/shutdown this
+        reads 0."""
+        with self._lock:
+            return len(self._owned)
+
+    def drain(self, timeout: float = 30.0) -> int:
+        """Stop accepting new requests and wait for the in-flight ones
+        to finish.  Returns the number still unresolved at the
+        deadline (0 = fully quiesced).  The engine stays alive —
+        ``resume()`` re-opens admission (the rolling weight-swap
+        choreography: drain → swap_params → warmup → resume)."""
+        with self._accept_lock:
+            if self._accepting:
+                self._reject = ("InferenceEngine is draining — not "
+                                "accepting requests (weight swap in "
+                                "progress)")
+                self._accepting = False
+        deadline = time.perf_counter() + float(timeout)
+        while self.inflight() and time.perf_counter() < deadline:
+            time.sleep(0.002)
+        return self.inflight()
+
+    def resume(self):
+        """Re-open admission after :meth:`drain`."""
+        if not self._alive:
+            raise MXNetError("cannot resume a closed InferenceEngine")
+        with self._accept_lock:
+            self._reject = None
+            self._accepting = True
+
+    def swap_params(self, params):
+        """Live weight swap: requires a drained engine (compiled bucket
+        executables bake the weights in as constants, so they are all
+        invalidated).  Call :meth:`warmup` before :meth:`resume` — a
+        lazy recompile inside the serving path is exactly the p99 spike
+        a rolling update exists to avoid."""
+        n = self.inflight()
+        if n:
+            raise MXNetError(
+                f"swap_params with {n} request(s) in flight — drain() "
+                "first (their batches would mix weight versions)")
+        with self._compile_lock:
+            self._model.set_params(params)
+            self._cache = {}
+            with self._lock:
+                self._bucket_ms.clear()  # re-learn: weights changed
+
+    def get_params(self):
+        """Host snapshot of the served weights (merged weights + aux)
+        — the rollback anchor a failed swap restores from."""
+        return self._model.get_params()
 
     def _count(self, name, value=1.0):
         self._metrics.inc(name, value)
@@ -969,8 +1066,12 @@ class DecodeEngine:
         self._active: List[_Stream] = []
         self._admitting: Optional[_Stream] = None
         self._accepting = True
+        self._reject = None  # drain(): submit's refusal message
         self._alive = True
         self._next_sid = 0
+        # accepted-but-unresolved futures — the inflight() snapshot
+        # the fleet router reads (see InferenceEngine.inflight)
+        self._owned: set = set()
 
         if prewarm:
             self.warmup()
@@ -984,9 +1085,16 @@ class DecodeEngine:
     # client surface
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, temperature=None,
-               eos_id=None) -> Future:
+               eos_id=None, seed=None) -> Future:
         """Enqueue one generation; the Future resolves to the np.int32
-        array of generated token ids (eos, when hit, is included)."""
+        array of generated token ids (eos, when hit, is included).
+
+        ``seed`` overrides the stream's sampling seed (default: the
+        engine-local stream id).  Sampling is keyed by (engine seed,
+        stream seed, position), so two engines constructed with the
+        same weights and engine ``seed`` produce BIT-IDENTICAL tokens
+        for the same (prompt, seed) — the property the fleet router's
+        exactly-once retry of a dead replica's requests rests on."""
         prompt = np.asarray(prompt)
         if prompt.ndim != 1 or prompt.size < 1:
             raise MXNetError(
@@ -1016,14 +1124,84 @@ class DecodeEngine:
         fut: Future = Future()
         with self._cond:
             if not self._accepting:
-                raise EngineClosedError("DecodeEngine is closed")
+                raise EngineClosedError(
+                    self._reject or "DecodeEngine is closed")
             s = _Stream(self._next_sid, prompt, max_new, temp, eos, fut,
-                        seed=self._next_sid + 1)
+                        seed=(self._next_sid + 1 if seed is None
+                              else int(seed)))
             self._next_sid += 1
             self._pending.append(s)
+            self._owned.add(fut)
             self._cond.notify_all()
+        fut.add_done_callback(self._disown)
         self._count("requests")
         return fut
+
+    def _disown(self, fut):
+        with self._lock:
+            self._owned.discard(fut)
+
+    def inflight(self) -> int:
+        """Accepted-but-unresolved generation count (pending + admitted
+        + mid-prefill).  Poisoned futures leave the count when their
+        exception lands, so a drained/dead engine reads 0."""
+        with self._lock:
+            return len(self._owned)
+
+    def drain(self, timeout: float = 30.0) -> int:
+        """Stop accepting new generations and wait for active streams
+        to retire.  Returns the unresolved count at the deadline (0 =
+        quiesced).  ``resume()`` re-opens admission."""
+        with self._cond:
+            if self._accepting:
+                self._reject = ("DecodeEngine is draining — not "
+                                "accepting requests (weight swap in "
+                                "progress)")
+                self._accepting = False
+        deadline = time.perf_counter() + float(timeout)
+        while self.inflight() and time.perf_counter() < deadline:
+            time.sleep(0.002)
+        return self.inflight()
+
+    def resume(self):
+        """Re-open admission after :meth:`drain`."""
+        with self._cond:
+            if not self._alive:
+                raise MXNetError("cannot resume a closed DecodeEngine")
+            self._reject = None
+            self._accepting = True
+            self._cond.notify_all()
+
+    def swap_params(self, params):
+        """Live weight swap.  Decode executables take the parameters as
+        RUNTIME arguments (nothing is baked in), so installing new
+        weights is one atomic reference swap — no recompile, and the
+        bucketed executable cache stays warm.  Takes effect at the next
+        prefill/decode step; the fleet drains first anyway so no stream
+        straddles two weight versions mid-generation."""
+        import jax
+
+        host = {k: v for k, v in params.items()}
+        missing = [n for n in self._param_names if n not in host]
+        if missing:
+            raise MXNetError(f"swap_params: params missing {missing}")
+        new = {}
+        for n in self._param_names:
+            v = host[n]
+            arr = np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+            old = self._params[n]
+            if tuple(arr.shape) != tuple(old.shape):
+                raise MXNetError(
+                    f"swap_params: param {n!r} shape {arr.shape} != "
+                    f"serving shape {tuple(old.shape)}")
+            new[n] = jax.device_put(arr.astype(old.dtype, copy=False),
+                                    self._device)
+        self._params = new
+
+    def get_params(self):
+        """Host snapshot of the served weights — the rollback anchor a
+        failed swap restores from."""
+        return {n: np.asarray(v) for n, v in self._params.items()}
 
     def generate(self, prompt, max_new_tokens=32, **kw) -> np.ndarray:
         """Synchronous convenience: ``submit(...).result()``."""
@@ -1500,3 +1678,125 @@ class DecodeEngine:
                     self._active.remove(s)
             for s in retired:
                 self._retire(s)
+
+
+# ---------------------------------------------------------------------------
+# fleet duty: the replica harness
+# ---------------------------------------------------------------------------
+
+
+class ReplicaHarness:
+    """One engine dressed for fleet duty (see ``mxnet_tpu.fleet``).
+
+    A :class:`fleet.Router` replica needs four things from whatever
+    engine it wraps, and this adapter is the one place they are wired:
+
+    * a **uniform submit surface** — :meth:`submit_infer` for
+      :class:`InferenceEngine`, :meth:`submit_decode` for
+      :class:`DecodeEngine` (the wrong kind refuses loudly);
+    * the **inflight() snapshot** — what would die with this engine;
+    * the **drain/resume hooks** the rolling weight swap drives;
+    * :meth:`swap` — load the newest committed, checksum-verified
+      weights from a checkpoint root (``checkpoint.load_latest_params``
+      — a training run's ``MXNET_CKPT_DIR`` or a
+      ``checkpoint.publish_params`` output), install them through the
+      engine's ``swap_params``, re-warm every executable, re-admit.
+      On ANY failure the engine resumes with its OLD weights — a swap
+      never leaves a replica refusing traffic.
+    """
+
+    def __init__(self, engine):
+        if not isinstance(engine, (InferenceEngine, DecodeEngine)):
+            raise MXNetError(
+                f"ReplicaHarness wraps an InferenceEngine or a "
+                f"DecodeEngine; got {type(engine)}")
+        self.engine = engine
+        self.kind = "decode" if isinstance(engine, DecodeEngine) \
+            else "infer"
+        self.weights_step = -1  # last swap's checkpoint step
+
+    # -- uniform submit -------------------------------------------------
+    def submit_infer(self, inputs) -> Future:
+        if self.kind != "infer":
+            raise MXNetError("replica serves decode requests; "
+                             "an inference request cannot ride it")
+        return self.engine.submit(inputs)
+
+    def submit_decode(self, prompt, max_new_tokens=32, temperature=None,
+                      eos_id=None, seed=None) -> Future:
+        if self.kind != "decode":
+            raise MXNetError("replica serves inference requests; "
+                             "a decode request cannot ride it")
+        return self.engine.submit(prompt, max_new_tokens,
+                                  temperature=temperature, eos_id=eos_id,
+                                  seed=seed)
+
+    # -- router-facing state --------------------------------------------
+    def inflight(self) -> int:
+        return self.engine.inflight()
+
+    def drain(self, timeout: float = 30.0) -> int:
+        return self.engine.drain(timeout=timeout)
+
+    def resume(self):
+        self.engine.resume()
+
+    def stats(self) -> dict:
+        out = self.engine.stats()
+        out["kind"] = self.kind
+        out["inflight"] = self.inflight()
+        out["weights_step"] = self.weights_step
+        return out
+
+    # -- rolling weight swap --------------------------------------------
+    def swap(self, ckpt_dir: str, drain_timeout: float = 60.0) -> dict:
+        """drain → load committed manifest (checksum-verified) → install
+        → warmup → re-admit.  Returns the timing/step report the router
+        aggregates.  Raises (with the engine RESUMED on old weights)
+        when the drain deadline passes with requests still in flight or
+        the checkpoint refuses verification."""
+        from .checkpoint import load_latest_params
+
+        report = {"kind": self.kind}
+        t0 = time.perf_counter()
+        left = self.drain(timeout=drain_timeout)
+        report["drain_ms"] = (time.perf_counter() - t0) * 1e3
+        try:
+            if left:
+                raise MXNetError(
+                    f"weight swap aborted: {left} request(s) still in "
+                    f"flight after the {drain_timeout:.0f}s drain "
+                    "deadline (router should have quiesced this "
+                    "replica first)")
+            t1 = time.perf_counter()
+            params, step, path = load_latest_params(ckpt_dir)
+            report["load_ms"] = (time.perf_counter() - t1) * 1e3
+            t2 = time.perf_counter()
+            old = self.engine.get_params()  # rollback anchor
+            installed = False
+            try:
+                self.engine.swap_params(params)
+                installed = True
+                self.engine.warmup()
+            except BaseException:
+                if installed:
+                    # warmup died AFTER the install: restore the old
+                    # weights before resuming, or re-admitted traffic
+                    # would silently serve the new version (and lazily
+                    # recompile in the serving path) while the router
+                    # believes the swap never happened
+                    self.engine.swap_params(old)
+                    self.engine.warmup()
+                raise
+            report["warmup_ms"] = (time.perf_counter() - t2) * 1e3
+            report["step"] = self.weights_step = step
+            report["path"] = path
+            profiler.inc_counter("serving.weight_swaps")
+            profiler.set_gauge("serving.weights_step", float(step))
+        finally:
+            self.resume()
+        report["total_ms"] = (time.perf_counter() - t0) * 1e3
+        return report
+
+    def close(self, timeout: float = 30.0):
+        self.engine.close(timeout=timeout)

@@ -1,0 +1,219 @@
+"""Shared wire-frame protocol primitives.
+
+The parameter-server transport (``ps.py``, PR 3) and the serving
+fleet's router/replica protocol (``fleet.py``) speak the same framing
+so the two cannot drift:
+
+* a frame is ``u32 length | body``; the first body byte is an op (or a
+  status byte on responses);
+* tensors ride a ``dtype-name | rank | shape | raw bytes`` encoding —
+  NO pickle on the wire, so a reachable port is not an
+  arbitrary-code-execution surface;
+* the few structured payloads (the pickled optimizer, remesh/fleet
+  control records) must carry an HMAC-SHA256 keyed by a
+  launcher-distributed secret, verified BEFORE the blob is parsed.
+
+Everything here is protocol-layer only: no sockets are owned, no
+threads are started.  ``ps.py`` re-exports the private-name aliases
+(``_pack_tensor`` etc.) its tests and older callers grew up with.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import socket
+import struct
+from typing import Tuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = [
+    "U32", "U64", "I64", "pack_key", "unpack_key", "pack_tensor",
+    "unpack_tensor", "send_frame", "recv_frame", "recv_exact",
+    "err_body", "raise_if_err", "sign", "verify", "pack_signed_json",
+    "unpack_signed_json", "is_transient",
+]
+
+U32 = struct.Struct("!I")
+U64 = struct.Struct("!Q")
+I64 = struct.Struct("!q")
+
+# errno values classified as TRANSIENT: a reconnect may heal them
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(__import__("errno"), n) for n in
+    ("ECONNRESET", "EPIPE", "ECONNABORTED", "ECONNREFUSED", "ETIMEDOUT")
+    if hasattr(__import__("errno"), n))
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Socket failures a bounded reconnect may heal (ECONNRESET/EPIPE
+    mid-frame, a restarting peer) — vs. protocol errors and response-
+    pipeline corruption, which must stay fatal."""
+    if isinstance(exc, ConnectionError):  # reset/refused/aborted/pipe
+        return True
+    if isinstance(exc, socket.timeout):
+        return False  # prolonged silence is a hang, not a blip
+    if isinstance(exc, OSError):
+        return exc.errno in _TRANSIENT_ERRNOS
+    return False
+
+
+# ---------------------------------------------------------------------------
+# keys and tensors
+# ---------------------------------------------------------------------------
+
+
+def pack_key(key) -> bytes:
+    if isinstance(key, (int, np.integer)):
+        return b"\x00" + I64.pack(int(key))
+    kb = str(key).encode()
+    if len(kb) > 0xFFFF:
+        raise MXNetError("key too long")
+    return b"\x01" + struct.pack("!H", len(kb)) + kb
+
+
+def unpack_key(buf: memoryview, off: int):
+    kind = buf[off]
+    off += 1
+    if kind == 0:
+        (k,) = I64.unpack_from(buf, off)
+        return int(k), off + 8
+    (n,) = struct.unpack_from("!H", buf, off)
+    off += 2
+    return bytes(buf[off:off + n]).decode(), off + n
+
+
+def pack_tensor(arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    # '<f4'-style typestrings are unambiguous and endian-tagged, but
+    # extension float dtypes (ml_dtypes bfloat16 — the bf16 gradient
+    # wire) stringify as an opaque '<V2'; ship their registered NAME
+    # ('bfloat16') instead, which np.dtype() resolves on the far side
+    ds = arr.dtype.str
+    dt = (arr.dtype.name if ds.lstrip("<>|=")[0] == "V" else ds).encode()
+    if arr.ndim > 0xFF or len(dt) > 0xFF:
+        raise MXNetError("tensor rank/dtype out of protocol range")
+    head = struct.pack("!B", len(dt)) + dt + struct.pack("!B", arr.ndim)
+    head += struct.pack(f"!{arr.ndim}I", *arr.shape) if arr.ndim else b""
+    return head + arr.tobytes()
+
+
+def _wire_dtype(token: str) -> np.dtype:
+    try:
+        return np.dtype(token)
+    except TypeError:
+        # extension dtype by name ('bfloat16'): registered by ml_dtypes
+        import ml_dtypes  # noqa: F401 — import registers the dtypes
+
+        return np.dtype(token)
+
+
+def unpack_tensor(buf: memoryview, off: int) -> Tuple[np.ndarray, int]:
+    dlen = buf[off]
+    off += 1
+    dt = _wire_dtype(bytes(buf[off:off + dlen]).decode())
+    off += dlen
+    ndim = buf[off]
+    off += 1
+    shape = struct.unpack_from(f"!{ndim}I", buf, off) if ndim else ()
+    off += 4 * ndim
+    n = int(np.prod(shape)) if shape else 1
+    nbytes = n * dt.itemsize
+    arr = np.frombuffer(buf[off:off + nbytes], dtype=dt).reshape(shape)
+    return arr, off + nbytes
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def send_frame(sock: socket.socket, body: bytes) -> None:
+    sock.sendall(U32.pack(len(body)) + body)
+
+
+def recv_frame(sock: socket.socket) -> memoryview:
+    hdr = recv_exact(sock, U32.size)
+    (n,) = U32.unpack(hdr)
+    return memoryview(recv_exact(sock, n))
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def err_body(msg: str) -> bytes:
+    """Response body for a server-side failure: status 1 + message."""
+    mb = msg.encode()[:0xFFFF]
+    return b"\x01" + struct.pack("!H", len(mb)) + mb
+
+
+def unpack_err(resp: memoryview) -> str:
+    """The message of an ``err_body`` response (resp[0] != 0)."""
+    (n,) = struct.unpack_from("!H", resp, 1)
+    return bytes(resp[3:3 + n]).decode()
+
+
+def raise_if_err(resp: memoryview, who: str = "server") -> memoryview:
+    """Responses start with a status byte: 0 = ok, else err_body."""
+    if resp[0] != 0:
+        raise MXNetError(f"{who}: {unpack_err(resp)}")
+    return resp
+
+
+# ---------------------------------------------------------------------------
+# authenticated structured payloads
+# ---------------------------------------------------------------------------
+
+
+def sign(secret: bytes, blob: bytes) -> bytes:
+    """HMAC-SHA256 tag for a structured payload."""
+    return _hmac.new(secret, blob, hashlib.sha256).digest()
+
+
+def verify(secret: bytes, blob: bytes, mac: bytes, what: str) -> None:
+    """Refuse an unkeyed or forged structured payload BEFORE parsing.
+
+    An empty key would make the MAC computable by anyone who can reach
+    the port — the exact remote-execution surface this protocol exists
+    to close — so a missing secret is as fatal as a bad MAC."""
+    if not secret:
+        raise MXNetError(
+            f"no HMAC secret configured — {what} refused (structured "
+            "payloads must be authenticated; distribute the secret "
+            "through the launcher)")
+    if not _hmac.compare_digest(mac, sign(secret, blob)):
+        raise MXNetError(f"{what} failed HMAC verification")
+
+
+def pack_signed_json(secret: bytes, obj) -> bytes:
+    """``u32 len | blob | 32-byte mac`` — the one structured-payload
+    encoding shared by the PS remesh frame and the fleet control ops."""
+    import json
+
+    blob = json.dumps(obj).encode()
+    return U32.pack(len(blob)) + blob + sign(secret, blob)
+
+
+def unpack_signed_json(secret: bytes, buf: memoryview, off: int,
+                       what: str):
+    import json
+
+    (blen,) = U32.unpack_from(buf, off)
+    off += 4
+    blob = bytes(buf[off:off + blen])
+    off += blen
+    mac = bytes(buf[off:off + 32])
+    verify(secret, blob, mac, what)
+    return json.loads(blob.decode()), off + 32

@@ -616,3 +616,100 @@ def test_decode_telemetry_surfaces(lm):
     assert "serving.time_per_token_ms" in summ["histograms"]
     assert "serving.cache_util" in summ["gauges"]
     assert "serving.active_streams" in summ["gauges"]
+
+
+# ---------------------------------------------------------------------------
+# fleet hooks: inflight snapshot, drain/resume, seed override, swap
+# ---------------------------------------------------------------------------
+
+
+def test_drain_path_inflight_matches_poisoned_count(lm):
+    """The router's view of what died with an engine: inflight() BEFORE
+    the close equals the number of futures poisoned with
+    EngineClosedError, and the count falls to 0 once they are failed
+    (no phantom ownership after the drain)."""
+    params, _, _ = lm
+    eng = _engine(params)
+    futs = [eng.submit(np.arange(1, 5, dtype=np.int32), 25)
+            for _ in range(3)]
+    time.sleep(0.05)
+    n_before = eng.inflight()
+    assert n_before == 3
+    eng.close(timeout=60)
+    poisoned = 0
+    for f in futs:
+        with pytest.raises(mx.EngineClosedError):
+            f.result(timeout=10)
+        poisoned += 1
+    assert poisoned == n_before
+    assert eng.inflight() == 0
+
+
+def test_decode_drain_resume_and_inflight(lm):
+    params, _, _ = lm
+    eng = _engine(params)
+    try:
+        assert eng.inflight() == 0
+        futs = [eng.submit(np.arange(1, 5, dtype=np.int32), 6)
+                for _ in range(2)]
+        left = eng.drain(timeout=120)
+        assert left == 0 and eng.inflight() == 0
+        for f in futs:
+            assert f.result(10).shape == (6,)  # drained, not dropped
+        with pytest.raises(mx.EngineClosedError, match="draining"):
+            eng.submit(np.arange(1, 5, dtype=np.int32), 4)
+        eng.resume()
+        out = eng.submit(np.arange(1, 5, dtype=np.int32), 4).result(60)
+        assert out.shape == (4,)
+    finally:
+        eng.close(timeout=30)
+
+
+def test_submit_seed_override_reproduces_across_engines(lm):
+    """Fleet retry determinism: the same (prompt, seed) sampled at
+    temperature > 0 yields identical tokens on a DIFFERENT engine with
+    different stream-id history — the property that lets a survivor
+    re-generate a dead replica's request bit-exactly."""
+    params, _, _ = lm
+    p = np.arange(1, 5, dtype=np.int32)
+    e1 = _engine(params)
+    try:
+        a = e1.submit(p, 6, temperature=0.7, seed=123).result(120)
+    finally:
+        e1.close(timeout=30)
+    e2 = _engine(params)
+    try:
+        e2.submit(p, 3).result(120)  # shift e2's stream-id history
+        b = e2.submit(p, 6, temperature=0.7, seed=123).result(120)
+        c = e2.submit(p, 6, temperature=0.7, seed=124).result(120)
+    finally:
+        e2.close(timeout=30)
+    assert np.array_equal(a, b)
+    assert not np.array_equal(b, c)  # the seed really keys sampling
+
+
+def test_decode_swap_params_identity_and_validation(lm):
+    """swap_params installs new weights without recompiling (params
+    are runtime args): identical weights → identical generation;
+    missing/mis-shaped params refuse loudly."""
+    params, _, naive = lm
+    eng = _engine(params)
+    try:
+        p = np.arange(1, 6, dtype=np.int32)
+        before = eng.submit(p, 5).result(120)
+        eng.swap_params(params)  # same weights, full round-trip
+        compiles_before = dict(eng.compiles)
+        after = eng.submit(p, 5).result(120)
+        assert np.array_equal(before, after)
+        assert dict(eng.compiles) == compiles_before  # no recompile
+        name = eng._param_names[0]
+        with pytest.raises(mx.MXNetError, match="missing"):
+            eng.swap_params({name: params[name]})
+        bad = {k: v for k, v in params.items()}
+        bad[name] = np.zeros((3, 3), np.float32)
+        with pytest.raises(mx.MXNetError, match="shape"):
+            eng.swap_params(bad)
+        # the failed swaps never installed anything
+        assert np.array_equal(eng.submit(p, 5).result(120), before)
+    finally:
+        eng.close(timeout=30)

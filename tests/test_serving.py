@@ -318,3 +318,87 @@ def test_boundary_flush_reason_counted():
         st = eng.stats()
         assert st["flush_boundary"] >= 1, st
         f2.result(timeout=30)  # the carried request still gets served
+
+
+# ---------------------------------------------------------------------------
+# fleet hooks: inflight snapshot, drain/resume, live weight swap
+# ---------------------------------------------------------------------------
+
+
+def test_inflight_snapshot_drain_and_resume():
+    pred, _, _ = _mlp_predictor()
+    eng = mx.InferenceEngine(pred, buckets=(1, 8), batch_timeout_ms=250.0,
+                             idle_timeout_ms=250.0)
+    try:
+        assert eng.inflight() == 0
+        futs = [eng.submit(np.zeros((1, 6), np.float32))
+                for _ in range(3)]
+        # the 250 ms coalesce window holds them: all still owned
+        assert eng.inflight() == 3
+        left = eng.drain(timeout=30.0)
+        assert left == 0 and eng.inflight() == 0
+        for f in futs:
+            assert f.result(1)[0].shape == (1, 4)  # drained = SERVED
+        with pytest.raises(mx.MXNetError, match="draining"):
+            eng.submit(np.zeros((1, 6), np.float32))
+        eng.resume()
+        out = eng.infer(np.zeros((1, 6), np.float32))
+        assert out[0].shape == (1, 4)
+    finally:
+        eng.close()
+
+
+def test_loop_death_poisoned_count_matches_inflight():
+    """The drain-path contract the fleet router reads: what inflight()
+    reported before the engine died is exactly how many futures get
+    poisoned, and the snapshot empties once they are failed."""
+    pred, _, _ = _mlp_predictor()
+    eng = mx.InferenceEngine(pred, buckets=(8,), batch_timeout_ms=500.0,
+                             idle_timeout_ms=500.0)
+    try:
+        futs = [eng.submit(np.zeros((1, 6), np.float32))
+                for _ in range(3)]
+        n_before = eng.inflight()
+        assert n_before == 3
+        # sabotage the coalescing loop (the test_decode poison recipe)
+        eng._timeout_s = eng._idle_timeout_s = None
+        fut4 = eng.submit(np.zeros((1, 6), np.float32))
+        poisoned = 0
+        for f in futs + [fut4]:
+            with pytest.raises(mx.EngineClosedError, match="died"):
+                f.result(timeout=30)
+            poisoned += 1
+        assert poisoned == n_before + 1
+        assert eng.inflight() == 0
+    finally:
+        eng._queue.put(None)
+        eng.close(timeout=5)
+
+
+def test_swap_params_guard_and_new_weights_served():
+    pred, net, (arg, aux) = _mlp_predictor()
+    eng = mx.InferenceEngine(pred, buckets=(1, 4), batch_timeout_ms=250.0,
+                             idle_timeout_ms=250.0)
+    try:
+        rng = np.random.RandomState(5)
+        x = rng.rand(1, 6).astype(np.float32)
+        base = eng.infer({"data": x})[0]
+        new_params = {k: np.asarray(v.asnumpy()
+                                    if hasattr(v, "asnumpy") else v) * 2.0
+                      for k, v in {**arg, **aux}.items()}
+        # guard: swapping with a request in flight refuses
+        fut = eng.submit({"data": x})  # sits in the 250 ms window
+        with pytest.raises(mx.MXNetError, match="in flight"):
+            eng.swap_params(new_params)
+        assert eng.drain(timeout=30.0) == 0
+        fut.result(1)
+        eng.swap_params(new_params)
+        eng.warmup()
+        eng.resume()
+        out = eng.infer({"data": x})[0]
+        assert not np.allclose(out, base), "old weights still served"
+        ref = mx.Predictor(net, new_params, {"data": (1, 6)})
+        ref.forward(data=x)
+        np.testing.assert_allclose(out, ref.get_output(0), rtol=1e-5)
+    finally:
+        eng.close()

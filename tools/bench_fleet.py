@@ -1,0 +1,348 @@
+#!/usr/bin/env python
+"""Fleet benchmark + chaos drill: closed-loop client sweep over
+replica counts, and the kill-one-replica acceptance drill.
+
+Prints ONE JSON line per mode (the `bench.py` convention):
+
+Sweep (default):
+  {"metric": "fleet_throughput", "value": N, "unit": "req/s",
+   "req_s": N, "p50_ms": N, "p90_ms": N, "p99_ms": N,
+   "shed_rate": N, "vs_single_replica": N, "sweep": [...], ...}
+
+Drill (--drill):
+  {"metric": "fleet_drill", "lost": 0, "mismatched": 0,
+   "replica_deaths": 1, "p99_trace_ms": [...], "swap_ok": true,
+   "swap_shed": 0, ...}
+
+Methodology (PERF.md appendix "Multi-replica serving"):
+- Replicas are REAL subprocesses, each wrapping a prewarmed
+  InferenceEngine over a deterministic tiny MLP (seeded weights, so
+  every replica — and the local reference — computes identical
+  outputs; a retried answer is checkable bit-for-bit).
+- Closed loop: C client threads each submit one request, block on the
+  future, submit the next — offered load scales with C, latency is
+  client-side submit→result wall.
+- The drill kills -9 one of two replicas MID-STREAM, then asserts:
+  zero lost requests (every future resolves), zero mismatches
+  (retried answers equal the reference — "match a single-replica
+  run"), bounded p99 (the per-second p99 trace is in the JSON), and a
+  rolling Router.swap_weights completes with zero shed/dropped
+  requests.
+
+Env knobs: FLEET_REPLICAS (CSV sweep, default "1,2"),
+FLEET_CLIENTS (default 4), FLEET_REQUESTS (per client, default 32),
+MXNET_FLEET_* (config.py), MXNET_DEAD_RANK_TIMEOUT /
+MXNET_HEARTBEAT_INTERVAL (conviction latency).
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np
+
+_DIM, _HIDDEN, _CLASSES = 16, 64, 8
+
+
+def log(msg):
+    print(f"[bench_fleet] {msg}", file=sys.stderr, flush=True)
+
+
+def _mlp_symbol():
+    import mxnet_tpu as mx
+
+    return mx.sym.FullyConnected(
+        mx.sym.Activation(
+            mx.sym.FullyConnected(mx.sym.Variable("data"),
+                                  num_hidden=_HIDDEN, name="fc1"),
+            act_type="relu"),
+        num_hidden=_CLASSES, name="fc2")
+
+
+def _mlp_params(scale=1.0):
+    rng = np.random.RandomState(0)
+    return {
+        "fc1_weight": (rng.randn(_HIDDEN, _DIM) * 0.1 * scale
+                       ).astype(np.float32),
+        "fc1_bias": np.zeros(_HIDDEN, np.float32),
+        "fc2_weight": (rng.randn(_CLASSES, _HIDDEN) * 0.1 * scale
+                       ).astype(np.float32),
+        "fc2_bias": np.zeros(_CLASSES, np.float32),
+    }
+
+
+def build_replica():
+    """Replica builder (runs INSIDE each replica process): identical
+    seeded weights everywhere, prewarmed buckets — a lazily compiled
+    bucket inside the drill would smear the p99 it measures."""
+    import mxnet_tpu as mx
+
+    pred = mx.Predictor(_mlp_symbol(), _mlp_params(),
+                        {"data": (1, _DIM)})
+    return mx.InferenceEngine(pred, buckets=(1, 4, 16),
+                              batch_timeout_ms=2.0, prewarm=True)
+
+
+def _reference():
+    import mxnet_tpu as mx
+
+    return mx.Predictor(_mlp_symbol(), _mlp_params(), {"data": (4, _DIM)})
+
+
+def _request(i):
+    rng = np.random.RandomState(1000 + i)
+    return rng.rand(1, _DIM).astype(np.float32)
+
+
+def _launch(n, fleet_dir, **router_kw):
+    from mxnet_tpu import fleet
+
+    log(f"launching {n} replica process(es) under {fleet_dir}")
+    router, procs = fleet.launch_local_fleet(
+        n, fleet_dir, os.path.abspath(__file__) + ":build_replica",
+        **router_kw)
+    return router, procs
+
+
+def _closed_loop(router, clients, per_client, lat_sink=None,
+                 check=None, deadline_ms=None):
+    """C closed-loop clients; returns (answered, lost, mismatched,
+    shed, latencies_ms sorted)."""
+    from mxnet_tpu.fleet import ShedError
+
+    lats, errs = [], {"lost": 0, "mismatched": 0, "shed": 0}
+    lock = threading.Lock()
+
+    def client(cid):
+        for k in range(per_client):
+            i = cid * per_client + k
+            x = _request(i)
+            t0 = time.perf_counter()
+            try:
+                out = router.submit({"data": x},
+                                    deadline_ms=deadline_ms).result(120)
+            except ShedError:
+                with lock:
+                    errs["shed"] += 1
+                continue
+            except BaseException as exc:  # noqa: BLE001
+                log(f"request {i} LOST: {exc}")
+                with lock:
+                    errs["lost"] += 1
+                continue
+            ms = (time.perf_counter() - t0) * 1e3
+            with lock:
+                lats.append(ms)
+                if lat_sink is not None:
+                    lat_sink.append((time.perf_counter(), ms))
+                if check is not None and not check(i, out[0]):
+                    errs["mismatched"] += 1
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return lats, errs, wall
+
+
+def _pcts(lats):
+    if not lats:
+        return {"p50_ms": None, "p90_ms": None, "p99_ms": None}
+    a = np.asarray(lats)
+    return {"p50_ms": round(float(np.percentile(a, 50)), 3),
+            "p90_ms": round(float(np.percentile(a, 90)), 3),
+            "p99_ms": round(float(np.percentile(a, 99)), 3)}
+
+
+def main_sweep(args):
+    from mxnet_tpu import fleet
+
+    counts = [int(x) for x in
+              os.environ.get("FLEET_REPLICAS", "1,2").split(",")]
+    clients = int(os.environ.get("FLEET_CLIENTS", "4"))
+    per_client = int(os.environ.get("FLEET_REQUESTS", "32"))
+    sweep = []
+    for n in counts:
+        fleet_dir = tempfile.mkdtemp(prefix=f"fleet-bench-{n}r-")
+        router, procs = _launch(n, fleet_dir)
+        try:
+            # warm the route (and the cost model) before timing
+            _closed_loop(router, 2, 4)
+            router.reset_stats()
+            lats, errs, wall = _closed_loop(router, clients, per_client)
+            stats = router.stats()
+            point = {"replicas": n, "clients": clients,
+                     "requests": len(lats),
+                     "req_s": round(len(lats) / wall, 2),
+                     "shed_rate": round(stats["shed_rate"], 4),
+                     "lost": errs["lost"], **_pcts(lats)}
+            sweep.append(point)
+            log(f"point: {point}")
+        finally:
+            router.close(stop_replicas=True)
+            for p in procs:
+                p.terminate()
+    best = max(sweep, key=lambda p: p["req_s"])
+    single = next((p for p in sweep if p["replicas"] == 1), None)
+    print(json.dumps({
+        "metric": "fleet_throughput", "value": best["req_s"],
+        "unit": "req/s", "req_s": best["req_s"],
+        "p50_ms": best["p50_ms"], "p90_ms": best["p90_ms"],
+        "p99_ms": best["p99_ms"], "shed_rate": best["shed_rate"],
+        "vs_single_replica": (round(best["req_s"] / single["req_s"], 2)
+                              if single and single["req_s"] else None),
+        "clients": clients, "model": "mlp", "sweep": sweep,
+    }))
+    return 0
+
+
+def main_drill(args):
+    """kill -9 one of two replicas under load; then a rolling swap."""
+    from mxnet_tpu import checkpoint as ckpt_mod
+
+    fleet_dir = args.fleet_dir or tempfile.mkdtemp(prefix="fleet-drill-")
+    router, procs = _launch(args.replicas, fleet_dir,
+                            replica_depth=4)
+    ref = _reference()
+    expect = {}
+
+    def check(i, out):
+        if i not in expect:
+            ref.forward(data=np.repeat(_request(i), 4, axis=0))
+            expect[i] = ref.get_output(0)[:1]
+        return np.allclose(out, expect[i], rtol=1e-5, atol=1e-6)
+
+    trace = []
+    try:
+        # warm routes + cost model
+        _closed_loop(router, 2, 4, check=check)
+        router.reset_stats()
+
+        clients = int(os.environ.get("FLEET_CLIENTS", "4"))
+        per_client = max(8, args.requests // clients)
+        total = clients * per_client
+        done_flag = threading.Event()
+
+        def killer():
+            # fire MID-STREAM: once a quarter of the answers landed
+            while len(trace) < max(2, total // 4) \
+                    and not done_flag.is_set():
+                time.sleep(0.005)
+            log(f"kill -9 replica pid {procs[0].pid}")
+            os.kill(procs[0].pid, signal.SIGKILL)
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        lats, errs, wall = _closed_loop(router, clients, per_client,
+                                        lat_sink=trace, check=check)
+        done_flag.set()
+        kt.join()
+        # the conviction may trail the last answer by a scan interval
+        deadline = time.monotonic() + 15.0
+        while router.stats()["replica_deaths"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        stats = router.stats()
+        log(f"post-kill stats: {stats}")
+
+        # per-second p99 trace (the PERF.md kill-one-replica figure)
+        t_start = trace[0][0] if trace else time.perf_counter()
+        buckets = {}
+        for t, ms in trace:
+            buckets.setdefault(int(t - t_start), []).append(ms)
+        p99_trace = [round(float(np.percentile(v, 99)), 2)
+                     for _, v in sorted(buckets.items())]
+
+        # rolling weight swap under fresh load: zero shed, zero lost
+        pub_dir = os.path.join(fleet_dir, "pub")
+        ckpt_mod.publish_params(pub_dir, _mlp_params(), step=2)
+        swap_errs = {}
+        stop = threading.Event()
+
+        def swap_load():
+            i = 0
+            while not stop.is_set():
+                try:
+                    router.submit({"data": _request(i)}).result(120)
+                except BaseException as exc:  # noqa: BLE001
+                    swap_errs[i] = str(exc)
+                i += 1
+
+        loaders = [threading.Thread(target=swap_load) for _ in range(2)]
+        shed_before = router.stats()["shed"]
+        for t in loaders:
+            t.start()
+        time.sleep(0.2)
+        try:
+            swap = router.swap_weights(pub_dir)
+            swap_ok = swap["step"] == 2 and len(swap["replicas"]) >= 1
+        except BaseException as exc:  # noqa: BLE001
+            log(f"swap failed: {exc}")
+            swap, swap_ok = {}, False
+        time.sleep(0.2)
+        stop.set()
+        for t in loaders:
+            t.join()
+        swap_shed = router.stats()["shed"] - shed_before \
+            + len(swap_errs)
+
+        verdict = {
+            "metric": "fleet_drill",
+            "replicas": args.replicas,
+            "requests": len(lats) + errs["lost"] + errs["shed"],
+            "lost": errs["lost"],
+            "mismatched": errs["mismatched"],
+            "shed": errs["shed"],
+            "replica_deaths": stats["replica_deaths"],
+            "retries": stats["retries"],
+            "duplicates": stats["duplicates"],
+            **_pcts(lats),
+            "p99_trace_ms": p99_trace,
+            "swap_ok": bool(swap_ok),
+            "swap_shed": int(swap_shed),
+            "swap_report": swap,
+            "wall_s": round(wall, 2),
+        }
+        print(json.dumps(verdict))
+        return 0 if (verdict["lost"] == 0 and verdict["mismatched"] == 0
+                     and verdict["replica_deaths"] == 1 and swap_ok
+                     and swap_shed == 0) else 1
+    finally:
+        router.close(stop_replicas=True)
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--drill", action="store_true",
+                    help="kill-one-replica acceptance drill")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--fleet-dir", default=None)
+    args = ap.parse_args()
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return main_drill(args) if args.drill else main_sweep(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
