@@ -61,6 +61,8 @@ from . import wire
 from . import fleet
 from .fleet import Router, FleetClient, ShedError
 from . import kv_cache
+from . import parallel
+from . import pp
 from . import sequence
 from . import monitor
 from .monitor import Monitor

@@ -618,6 +618,17 @@ class CheckpointManager:
             return gather_global(d)
 
         arg_params, aux_params = module.get_params()
+        # mesh descriptor: informational only — the state itself is
+        # layout-independent (ZeRO shards gathered to param-shaped
+        # values), so a dp×tp checkpoint restores under dp×tp×pp and
+        # vice versa; the descriptor lets ckpt_inspect and cross-layout
+        # debugging name the layout that WROTE the checkpoint
+        plan = getattr(module, "_mesh_plan", None)
+        mesh = None
+        if plan is not None:
+            mesh = {"dp": plan.dp, "tp": plan.tp,
+                    "pp": getattr(plan, "pp", 1),
+                    "microbatches": getattr(plan, "microbatches", 1)}
         snap: Dict[str, Any] = {
             "format": FORMAT,
             "step": int(step),
@@ -625,6 +636,7 @@ class CheckpointManager:
             "nbatch": int(nbatch),
             "rank": self.rank,
             "num_shards": self.num_shards,
+            "mesh": mesh,
             "reason": reason,
             "wall_time": time.time(),
             "arg_params": {k: stable(v) for k, v in arg_params.items()},

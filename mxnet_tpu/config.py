@@ -73,6 +73,54 @@ register_env(
     "update on every device (the pre-ZeRO behavior).  Checkpointed "
     "optimizer states are layout-independent either way.")
 register_env(
+    "MXNET_PP", 1, int,
+    "Pipeline-parallel degree of the device mesh built by "
+    "parallel.make_plan (the kvstore='tpu' idiom): the mesh becomes "
+    "dp x pp x tp and the fused training step runs the mxnet_tpu.pp "
+    "interleaved-1F1B microbatch pipeline over __pp_block__-annotated "
+    "models (models/transformer.py).  The layer count must divide by "
+    "pp.  Garbage ('banana'), zero or negative values raise at plan "
+    "construction.")
+register_env(
+    "MXNET_MICROBATCHES", None, int,
+    "Microbatch count of the pipeline schedule (= gradient-"
+    "accumulation depth inside the ONE fused program).  Unset: 2*pp "
+    "when pp > 1, else 1.  The global batch must divide by "
+    "dp x microbatches (MeshPlan.check_batch).  More microbatches "
+    "shrink the pipeline bubble — (pp-1)/(microbatches+pp-1) — at the "
+    "cost of per-microbatch activation stash.  Garbage, zero or "
+    "negative values raise at plan construction.")
+register_env(
+    "MXNET_PARTITION_RULES", None, str,
+    "Logical-axis partition rules table as ';'-separated 'regex:axis' "
+    "entries, first match wins, axis '-' = replicated (e.g. "
+    "'batch:dp;vocab|qkv|heads|ffn:tp;embed|length:-').  Parameters "
+    "and activations carry logical axis names "
+    "(parallel.logical_axes); every placement — params, inputs, "
+    "activations, ZeRO optimizer state ('zero' axis) — resolves "
+    "through this ONE table.  A named axis no rule matches raises "
+    "loudly.  Malformed entries raise at plan construction.")
+register_env(
+    "MXNET_PP_CONSTRAIN", 0, int,
+    "1: pin the pipeline's (stage, microbatch, ...) activation stash "
+    "to its stage-resident P('pp', ...) placement with explicit "
+    "sharding constraints.  0 (default): leave the stash layout to "
+    "XLA's propagation — required on this jaxlib, whose SPMD "
+    "partitioner miscompiles roll/select updates of a 'pp'-sharded "
+    "scan carry at some shapes (silently wrong values; the "
+    "pp-vs-single-process equivalence tests catch it).  Turn on with "
+    "newer toolchains to guarantee stage placement.")
+register_env(
+    "MXNET_PP_SCHEDULE", "1f1b", str,
+    "Pipeline microbatch schedule: '1f1b' (default, interleaved "
+    "PipeDream-flush compute ordering) or 'gpipe' (all-forwards-then-"
+    "all-backwards).  Both run in the optimal 2*(microbatches + pp - "
+    "1) ticks, and in this implementation both keep the full (pp x "
+    "microbatches) activation stash — 1f1b changes compute order "
+    "(and bounds the LIVE window on stage-resident runs), it does "
+    "not shrink the stash allocation today.  Unknown values raise "
+    "when the fused step is built.")
+register_env(
     "MXNET_CONV_LAYOUT", "NCHW", str,
     "Internal lowering layout for 2-D Convolution: 'NCHW' (default, "
     "direct) or 'NHWC' (channels-last dimension numbers with "

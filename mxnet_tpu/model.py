@@ -123,6 +123,12 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     """Save symbol json + params (reference: model.py save_checkpoint;
     format: prefix-symbol.json + prefix-%04d.params).
 
+    Parameters are saved LAYOUT-INDEPENDENTLY: a tensor- or pipeline-
+    sharded (tp/pp mesh) array is gathered to its full host value
+    first — on a process-spanning mesh every rank must call this in
+    lockstep (the gather is a collective).  The checkpoint then loads
+    under ANY mesh layout, matching the PR-4 optimizer-state contract.
+
     Both files are written crash-safely (tmp file + fsync +
     ``os.replace``): a kill at any point leaves either the previous
     checkpoint or the new one on disk, never a truncated hybrid."""
@@ -130,8 +136,15 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
 
     if symbol is not None:
         atomic_save(f"{prefix}-symbol.json", symbol.save)
-    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
-    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+
+    def full(v):
+        d = getattr(v, "_data", None)
+        if d is not None and not getattr(d, "is_fully_addressable", True):
+            return nd.array(nd.gather_global(v))
+        return v
+
+    save_dict = {f"arg:{k}": full(v) for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": full(v) for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
     atomic_save(param_name, lambda tmp: nd.save(tmp, save_dict))
     logging.info('Saved checkpoint to "%s"', param_name)

@@ -12,9 +12,38 @@ Sequence parallelism: the same attention primitive is distributed by
 ``mxnet_tpu.sequence`` (ring / Ulysses) over an 'sp' mesh axis — see
 ``__graft_entry__.dryrun_multichip`` and tests/test_sequence.py; this
 symbol graph is the single-shard program those wrap.
+
+3D parallelism: every weight carries LOGICAL axis names (``('vocab',
+'embed')``, ``('qkv', 'embed')`` …) and every residual block carries a
+``__pp_block__`` annotation — sharding comes from ONE rules table
+(:func:`lm_partition_rules` or your own, via ``MeshPlan(rules=...)``)
+and pipeline stages from ``MeshPlan(pp=...)``, with **zero** per-op
+``__shard__`` attrs anywhere in this file.  See README "3D
+parallelism".
 """
 
 from .. import symbol as sym
+from ..attribute import AttrScope
+from ..parallel import logical_axes
+
+
+def lm_partition_rules(sequence_parallel: bool = False):
+    """The canonical rules table for this model family: first match
+    wins, ``None`` = replicated.  Feed to ``MeshPlan(rules=...)`` (or
+    set ``MXNET_PARTITION_RULES=batch:dp;vocab|qkv|heads|ffn:tp;...``).
+
+    ``sequence_parallel=True`` additionally shards the 'length'
+    activation axis over 'tp' between attention calls (the Megatron-SP
+    layout; composes with the ring-attention 'sp' path)."""
+    return (
+        ("batch", "dp"),
+        ("vocab", "tp"),
+        ("qkv", "tp"),
+        ("heads", "tp"),
+        ("ffn", "tp"),
+        ("length", "tp" if sequence_parallel else None),
+        ("embed", None),
+    )
 
 
 def _block(x, d_model, num_heads, d_ff, name, causal, dropout,
@@ -25,22 +54,35 @@ def _block(x, d_model, num_heads, d_ff, name, causal, dropout,
     # exist between the two matmuls (they measured ~20 ms/step at
     # GPT-2-small scale; tools/profile_transformer.py, PERF.md)
     h = sym.LayerNorm(x, name=f"{name}_ln1")
-    qkv = sym.FullyConnected(h, num_hidden=3 * d_model, flatten=False,
-                             name=f"{name}_qkv")
+    qkv = sym.FullyConnected(
+        h, num_hidden=3 * d_model, flatten=False, name=f"{name}_qkv",
+        weight=sym.Variable(f"{name}_qkv_weight",
+                            attr=logical_axes("qkv", "embed")),
+        bias=sym.Variable(f"{name}_qkv_bias", attr=logical_axes("qkv")))
     att = sym.QKVSelfAttention(qkv, num_heads=num_heads, causal=causal,
                                block_size=block_size, name=f"{name}_attn")
-    att = sym.FullyConnected(att, num_hidden=d_model, flatten=False,
-                             name=f"{name}_proj")
+    att = sym.FullyConnected(
+        att, num_hidden=d_model, flatten=False, name=f"{name}_proj",
+        weight=sym.Variable(f"{name}_proj_weight",
+                            attr=logical_axes("embed", "heads")),
+        bias=sym.Variable(f"{name}_proj_bias",
+                          attr=logical_axes("embed")))
     if dropout > 0:
         att = sym.Dropout(att, p=dropout, name=f"{name}_attn_drop")
     x = x + att
     # feed-forward sublayer (pre-LN, GELU)
     h = sym.LayerNorm(x, name=f"{name}_ln2")
-    h = sym.FullyConnected(h, num_hidden=d_ff, flatten=False,
-                           name=f"{name}_ff1")
+    h = sym.FullyConnected(
+        h, num_hidden=d_ff, flatten=False, name=f"{name}_ff1",
+        weight=sym.Variable(f"{name}_ff1_weight",
+                            attr=logical_axes("ffn", "embed")),
+        bias=sym.Variable(f"{name}_ff1_bias", attr=logical_axes("ffn")))
     h = sym.Activation(h, act_type="gelu", name=f"{name}_gelu")
-    h = sym.FullyConnected(h, num_hidden=d_model, flatten=False,
-                           name=f"{name}_ff2")
+    h = sym.FullyConnected(
+        h, num_hidden=d_model, flatten=False, name=f"{name}_ff2",
+        weight=sym.Variable(f"{name}_ff2_weight",
+                            attr=logical_axes("embed", "ffn")),
+        bias=sym.Variable(f"{name}_ff2_bias", attr=logical_axes("embed")))
     if dropout > 0:
         h = sym.Dropout(h, p=dropout, name=f"{name}_ff_drop")
     return x + h
@@ -69,21 +111,34 @@ def transformer_lm(vocab_size, seq_len, num_layers=4, num_heads=4,
     data = sym.Variable("data")
     label = sym.Variable("softmax_label")
     x = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
-                      name="tok_embed")
+                      name="tok_embed",
+                      weight=sym.Variable(
+                          "tok_embed_weight",
+                          attr=logical_axes("vocab", "embed")))
     if dtype != "float32":
         x = sym.Cast(x, dtype=dtype, name="embed_cast")
     # learned positional embedding: a (T, d) parameter broadcast over
     # the batch (declared shape so inference doesn't depend on a
     # position-id input)
     pos = sym.Variable("pos_embed_weight", shape=(seq_len, d_model),
-                       dtype=dtype, init="[\"zero\", {}]")
-    x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0))
+                       dtype=dtype, init="[\"zero\", {}]",
+                       attr=logical_axes("length", "embed"))
+    x = sym.broadcast_add(x, sym.expand_dims(pos, axis=0),
+                          attr={"__logical__": "batch,length,embed"})
     for i in range(num_layers):
-        x = _block(x, d_model, num_heads, d_ff, f"layer{i}", causal,
-                   dropout, block_size)
+        # __pp_block__ marks the pipeline-splittable trunk: every op
+        # (and auto-created weight) of block i carries the annotation,
+        # so MeshPlan(pp=S) can cut the graph into S stages
+        # (mxnet_tpu.pp.split_blocks)
+        with AttrScope(__pp_block__=str(i)):
+            x = _block(x, d_model, num_heads, d_ff, f"layer{i}", causal,
+                       dropout, block_size)
     x = sym.LayerNorm(x, name="ln_f")
-    logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
-                                name="head")
+    logits = sym.FullyConnected(
+        x, num_hidden=vocab_size, flatten=False, name="head",
+        weight=sym.Variable("head_weight",
+                            attr=logical_axes("vocab", "embed")),
+        bias=sym.Variable("head_bias", attr=logical_axes("vocab")))
     if head == "ce":
         return sym.SoftmaxCELoss(logits, label, use_ignore=True,
                                  ignore_label=0, name="softmax")

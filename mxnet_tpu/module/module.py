@@ -397,10 +397,16 @@ class Module(BaseModule):
             self.params_initialized = True
 
     def _apply_mesh_plan(self):
-        """Pin every executor array to its mesh placement: inputs batch-
-        sharded over 'dp', params/aux replicated unless a '__shard__'
-        symbol attr — or the param's ctx_group via the plan's group2ctx
-        mapping (model-parallel layer groups) — requests sharding."""
+        """Pin every executor array to its mesh placement, resolved
+        through the plan's ONE partition-rules table: inputs carry the
+        'batch' logical axis (rules map it to 'dp'), params resolve
+        their '__logical__' axis names, and the legacy paths — a
+        '__shard__' symbol attr, an op-level '__shard__' hint, or the
+        param's ctx_group via the plan's group2ctx mapping — each
+        synthesize a single-param rule (deprecation shim) so old
+        annotations shard identically through the same table."""
+        from ..parallel import parse_logical
+
         plan = self._mesh_plan
         attrs = self._symbol.attr_dict()
         input_names = set(self._data_names) | set(self._label_names)
@@ -464,6 +470,7 @@ class Module(BaseModule):
                             np.zeros(tuple(arr.shape), arr.dtype), arr.ndim)
                     continue
             else:
+                axes = parse_logical(attrs.get(name, {}).get("__logical__"))
                 shard = attrs.get(name, {}).get("__shard__")
                 if shard is None and name in op_shards:
                     # op-level hint is best-effort per param: a bias
@@ -486,7 +493,11 @@ class Module(BaseModule):
                         # bias can't shard on the matrix dim — replicate
                         if int(parts[1]) >= arr.ndim:
                             shard = None
-                sh = plan.param_sharding(arr.ndim, shard)
+                # logical axis names win; the __shard__ forms are the
+                # deprecation shim (each synthesizes a single-param rule
+                # inside param_sharding)
+                sh = plan.param_sharding(arr.ndim, attr=shard, axes=axes,
+                                         shape=tuple(arr.shape), name=name)
             arr._sharding = sh
             if spans:
                 # unify the per-process initializations: rank 0's value
@@ -625,6 +636,18 @@ class Module(BaseModule):
                 "Module.remesh re-shards the in-program (fused/ZeRO) "
                 "state; an update_on_kvstore module re-meshes through "
                 "DistKVStore.remesh instead")
+        old_pp = getattr(self._mesh_plan, "pp", 1) if self._mesh_plan else 1
+        new_pp = getattr(plan, "pp", 1)
+        if old_pp > 1 or new_pp > 1:
+            # elastic re-mesh is dp-only today: the rollback path
+            # re-scatters flat 'dp'-sharded ZeRO slices, and silently
+            # re-scattering state entangled with a pipeline ('pp') axis
+            # would corrupt it.  Fail loudly instead of corrupting.
+            raise NotImplementedError(
+                f"Module.remesh on a pipeline-parallel plan (pp="
+                f"{max(old_pp, new_pp)}) is not implemented: elastic "
+                "re-mesh is dp-only today; restore a committed "
+                "checkpoint into a freshly-bound pp module instead")
         opt_payload = None
         if self.optimizer_initialized:
             opt_payload = self._optimizer_states_to_host(lazy=False)
@@ -861,10 +884,19 @@ class Module(BaseModule):
         Subsumes the reference's per-node engine pushes + kvstore
         push/pull + per-weight optimizer kernels into a single fused
         computation — XLA overlaps backward with updates and keeps all
-        buffers on-chip (donated)."""
+        buffers on-chip (donated).
+
+        On a pipeline-parallel plan (pp > 1, or microbatches > 1) the
+        forward+backward segment is the mxnet_tpu.pp microbatch
+        pipeline instead of one whole-graph vjp — same signature, same
+        optimizer segment."""
         import functools
         import jax
         import jax.numpy as jnp
+
+        plan = self._mesh_plan
+        if plan is not None and (plan.pp > 1 or plan.microbatches > 1):
+            return self._build_pipelined_step()
 
         graph_fn = self._exec._graph_fn
         do_mirror = self._exec._do_mirror
@@ -907,10 +939,92 @@ class Module(BaseModule):
 
         return jax.jit(step, donate_argnums=(0, 3, 7))
 
+    def _build_pipelined_step(self):
+        """The pp>1 fused step: ONE donated XLA program whose
+        forward+backward segment is the mxnet_tpu.pp interleaved-1F1B
+        microbatch pipeline (vmapped stages over the 'pp' mesh axis,
+        collective-permute activation transfers, per-stage
+        recompute-backward), whose gradients arrive already ACCUMULATED
+        across microbatches, and whose optimizer segment is the very
+        same ``_make_param_update`` (ZeRO-1 over 'dp') the non-pipelined
+        step uses — 3D parallelism composed, not wired per model."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import config as _config
+        from .. import pp as _pp
+        from ..base import get_env
+
+        plan = self._mesh_plan
+        if self._aux_names:
+            raise MXNetError(
+                "pipeline parallelism (pp > 1 / microbatches > 1) does "
+                "not support auxiliary-state ops (e.g. BatchNorm moving "
+                f"stats); this symbol carries {self._aux_names[:4]}")
+        try:
+            pg = _pp.split_blocks(self._symbol)
+        except MXNetError as e:
+            if plan.pp == 1:
+                # the user asked only for microbatching; name the real
+                # requirement instead of blaming a pp degree they
+                # never set
+                raise MXNetError(
+                    f"microbatches={plan.microbatches} runs the fused "
+                    "step through the pipeline executor, which needs "
+                    "__pp_block__ annotations on the model's repeated "
+                    f"trunk even at pp=1: {e}")
+            raise
+        input_names = set(self._data_names) | set(self._label_names)
+        direct = sorted({n for row in pg.block_params for n in row
+                         if n in input_names})
+        if direct:
+            raise MXNetError(
+                f"pipeline block(s) consume graph input(s) {direct} "
+                "directly; keep an un-annotated pre region (embedding/"
+                "projection) in front of the first __pp_block__")
+        # per-param resolved specs so stacked per-stage views keep
+        # their rules-table tensor shardings
+        param_specs = {}
+        for n in self._param_names:
+            sh = getattr(self._exec.arg_dict[n]._data, "sharding", None)
+            spec = getattr(sh, "spec", None)
+            param_specs[n] = tuple(spec) if spec is not None else ()
+        kind = get_env("MXNET_PP_SCHEDULE",
+                       _config.describe("MXNET_PP_SCHEDULE").default, str)
+        pipe = _pp.build_pipeline_fn(pg, plan, self._grad_param_names,
+                                     param_specs, schedule_kind=kind)
+        self._pp_schedule = pipe.schedule
+        update = self._make_param_update()
+        prologue = self._input_prologue
+        pnames = list(self._grad_param_names)
+
+        def step(params, fixed, aux, states, inputs, key, lr, t):
+            rng = jax.random.fold_in(key, t)
+            if prologue is not None:
+                inputs = prologue(inputs, jax.random.fold_in(key, -1 - t),
+                                  True)
+            args = dict(fixed)
+            args.update(params)
+            outs, grads = pipe(args, inputs, rng, True)
+            # a trainable param outside every region (unused) gets a
+            # zero gradient rather than a KeyError
+            grads = {n: grads.get(n, jnp.zeros_like(params[n]))
+                     for n in pnames}
+            t_f = (t + 1).astype(jnp.float32)
+            new_params, new_states = update(params, grads, states, lr,
+                                            t_f)
+            return list(outs), new_params, dict(aux), new_states, t + 1
+
+        return jax.jit(step, donate_argnums=(0, 3, 7))
+
     def _make_param_update(self):
         """The optimizer segment of the fused program, shared by
-        _build_fused_step and _build_apply_grads: (params, grads,
-        states, lr, t_f) → (new_params, new_states).
+        _build_fused_step, _build_pipelined_step and _build_apply_grads:
+        (params, grads, states, lr, t_f) → (new_params, new_states).
+        Under pipeline parallelism the incoming ``grads`` are already
+        accumulated (summed) across every microbatch by the pp scan, so
+        ONE ZeRO update consumes the full-batch gradient — identical
+        semantics to the non-pipelined step.
 
         Replicated mode (default off-mesh): ``optimizer.apply`` runs on
         every full parameter on every device — the state and the update

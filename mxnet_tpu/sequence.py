@@ -41,6 +41,23 @@ from .ops.attention import (attention_state_init, attention_state_merge,
 __all__ = ["sequence_mesh", "ring_attention", "ulysses_attention"]
 
 
+def _shard_map(f, mesh, in_specs, out_specs, check: bool):
+    """Version shim: ``jax.shard_map(..., check_vma=)`` (jax >= 0.6)
+    vs ``jax.experimental.shard_map.shard_map(..., check_rep=)``
+    (0.4.x/0.5.x) — same semantics, renamed flag."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    # 0.4.x's replication checker miscounts cond-over-ppermute bodies
+    # (the ring's remat backward); its own error message prescribes
+    # check_rep=False — scoped to the legacy API, new-jax runs keep
+    # full vma checking
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
 def sequence_mesh(sp: Optional[int] = None, devices=None,
                   axis_name: str = "sp") -> Mesh:
     """A 1-D mesh over the sequence-parallel axis."""
@@ -100,7 +117,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     """Sequence-parallel attention: (B, T, H, D) global arrays with T
     sharded over ``axis_name``; returns same-sharded output."""
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           causal=causal, block_size=block_size),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -109,7 +126,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
         # block dynamic_slices; vma checking rejects that pairing, so
         # follow JAX's prescribed workaround — scoped to interpret mode
         # only, so native TPU runs and the lax path keep full checking
-        check_vma=not (_pk.enabled() and _pk._interpret()))
+        check=not (_pk.enabled() and _pk._interpret()))
     return fn(q, k, v)
 
 
@@ -143,9 +160,9 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     """All-to-all sequence parallelism (Ulysses): T sharded in/out,
     heads sharded during the attention itself."""
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name,
                           causal=causal, block_size=block_size),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=not (_pk.enabled() and _pk._interpret()))
+        check=not (_pk.enabled() and _pk._interpret()))
     return fn(q, k, v)

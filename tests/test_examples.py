@@ -86,3 +86,15 @@ def test_model_parallel_lstm_mesh():
 def test_ssd_example():
     out = run_example("ssd.py", "--num-epochs", "2", "--batch-size", "4")
     assert "detections per image" in out
+
+
+@pytest.mark.slow
+def test_train_transformer_lm_3d_mesh():
+    """The transformer-LM example: full dp×tp×pp from the rules table,
+    zero per-op shard attrs (README '3D parallelism').  Slow marker:
+    a fresh-process compile of the pipelined step; the same semantics
+    run in-process in tests/test_pp.py::test_transformer_lm_rules_3d."""
+    out = run_example("train_transformer_lm.py", "--num-steps", "8",
+                      mesh=True)
+    assert "train_transformer_lm OK" in out
+    assert "dp=2 tp=2 pp=2" in out

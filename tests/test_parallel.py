@@ -155,6 +155,132 @@ def test_batch_not_divisible_raises():
                  for_training=True)
 
 
+def test_partition_rules_first_match_wins():
+    rules = parallel.PartitionRules([("foo", "tp"), ("foo|bar", "dp")])
+    assert rules.axis_for("foo") == "tp"
+    assert rules.axis_for("bar") == "dp"
+    # ordering is the contract: flipped table flips the answer
+    flipped = parallel.PartitionRules([("foo|bar", "dp"), ("foo", "tp")])
+    assert flipped.axis_for("foo") == "dp"
+
+
+def test_partition_rules_scalar_and_size1_unpartitioned():
+    rules = parallel.PartitionRules([("hidden", "dp")])
+    assert rules.spec(()) == ()
+    assert rules.spec(("hidden",), shape=(1,)) == (None,)
+    assert rules.spec(("hidden", None), shape=(8, 4)) == ("dp", None)
+
+
+def test_partition_rules_unmatched_raises_naming_param():
+    rules = parallel.PartitionRules([("batch", "dp")])
+    with pytest.raises(mx.base.MXNetError, match="fc1_weight"):
+        rules.spec(("mystery", "embed"), param="fc1_weight")
+
+
+def test_partition_rules_duplicate_axis_rejected():
+    rules = parallel.PartitionRules([("a|b", "tp")])
+    with pytest.raises(mx.base.MXNetError, match="same mesh axis"):
+        rules.spec(("a", "b"), shape=(4, 4), param="w")
+
+
+def test_partition_rules_parse_and_validation():
+    rules = parallel.PartitionRules.parse(
+        "batch:dp;vocab|qkv:tp;embed|length:-")
+    assert rules.axis_for("vocab") == "tp"
+    assert rules.axis_for("embed") is None
+    for bad in ("novalue", "", "(:dp"):
+        with pytest.raises(mx.base.MXNetError):
+            parallel.PartitionRules.parse(bad)
+    # unknown mesh axis caught at plan construction
+    import jax
+
+    with pytest.raises(mx.base.MXNetError, match="unknown mesh axis"):
+        parallel.MeshPlan(jax.devices(), rules=[("vocab", "model")])
+
+
+def test_rules_resolve_params_activations_optstate_identically():
+    """ONE table answers for parameters, activations and the ZeRO
+    optimizer state — the single resolution point."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    plan = parallel.MeshPlan(
+        jax.devices(), dp=2, tp=2, pp=2,
+        rules=[("vocab", "tp"), ("embed", None), ("length", None)])
+    assert plan.param_sharding(2, axes=("vocab", "embed"),
+                               shape=(32, 16)).spec == P("tp", None)
+    assert plan.input_sharding(3).spec == P("dp", None, None)
+    assert plan.activation_spec(("batch", "length", "embed")) \
+        == P("dp", None, None)
+    assert plan.opt_state_sharding().spec == P("dp")
+    # user rules override the built-in tail (first match wins)
+    plan2 = parallel.MeshPlan(jax.devices(), dp=2, tp=2, pp=2,
+                              rules=[("zero", None), ("batch", "dp")])
+    assert plan2.opt_state_sharding().spec == P(None)
+
+
+def test_shard_attr_shim_matches_rules():
+    """The deprecated __shard__ attr synthesizes a single-param rule:
+    old annotations shard IDENTICALLY to the logical-axis path."""
+    import jax
+
+    plan = parallel.MeshPlan(jax.devices(), dp=4, tp=2,
+                             rules=[("hidden", "tp")])
+    legacy = plan.param_sharding(2, attr="tp:0", name="fc1_weight")
+    modern = plan.param_sharding(2, axes=("hidden", None),
+                                 shape=(16, 8), name="fc1_weight")
+    assert legacy.spec == modern.spec
+    # and the existing validation still bites
+    with pytest.raises(mx.base.MXNetError):
+        plan.param_sharding(2, attr="model:0")
+    with pytest.raises(mx.base.MXNetError):
+        plan.param_sharding(1, attr="tp:3")
+
+
+def test_pp_env_validation(monkeypatch):
+    """MXNET_PP / MXNET_MICROBATCHES / MXNET_PARTITION_RULES validate
+    loudly at plan construction (the MXNET_CKPT_* pattern)."""
+    for bad in ("banana", "-3", "0", "1.5"):
+        monkeypatch.setenv("MXNET_PP", bad)
+        with pytest.raises(mx.base.MXNetError):
+            parallel.make_plan()
+    monkeypatch.delenv("MXNET_PP")
+    for bad in ("banana", "-3", "0"):
+        monkeypatch.setenv("MXNET_MICROBATCHES", bad)
+        with pytest.raises(mx.base.MXNetError):
+            parallel.make_plan()
+    monkeypatch.delenv("MXNET_MICROBATCHES")
+    monkeypatch.setenv("MXNET_PARTITION_RULES", "no-colon-entry")
+    with pytest.raises(mx.base.MXNetError):
+        parallel.make_plan()
+    monkeypatch.delenv("MXNET_PARTITION_RULES")
+    # the happy path: env-driven pp plan
+    monkeypatch.setenv("MXNET_PP", "2")
+    monkeypatch.setenv("MXNET_MICROBATCHES", "4")
+    monkeypatch.setenv("MXNET_PARTITION_RULES", "batch:dp;hidden:tp")
+    plan = parallel.make_plan(tp=2)
+    assert plan.pp == 2 and plan.microbatches == 4
+    assert plan.rules.axis_for("hidden") == "tp"
+
+
+def test_check_batch_microbatch_divisibility():
+    import jax
+
+    plan = parallel.MeshPlan(jax.devices(), dp=2, tp=2, pp=2,
+                             microbatches=3)
+    with pytest.raises(mx.base.MXNetError, match="microbatches"):
+        plan.check_batch(8)  # 8 % (2*3) != 0
+    plan.check_batch(12)
+    # bind-time enforcement through the module path
+    it_shapes = [("data", (8, 8))]
+    mod = mx.mod.Module(_build_mlp(), context=mx.cpu())
+    mod._mesh_plan = plan
+    with pytest.raises(mx.base.MXNetError, match="microbatches"):
+        mod.bind(data_shapes=it_shapes,
+                 label_shapes=[("softmax_label", (8,))],
+                 for_training=True)
+
+
 def test_ctx_group_group2ctx_mesh_mapping():
     """AttrScope(ctx_group=...) + group2ctx places a layer group's
     params on a mesh axis (the reference model-parallel idiom,

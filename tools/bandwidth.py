@@ -70,16 +70,19 @@ def main():
             np.tile(shard, n), NamedSharding(mesh, P("dp")))
         mesh_bytes = sharded.nbytes  # actual measured array size
 
+        from mxnet_tpu.sequence import _shard_map  # jax-version shim
+
         psum_fn = jax.jit(
-            jax.shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
-                          in_specs=P("dp"), out_specs=P()))
+            _shard_map(lambda x: jax.lax.psum(x, "dp"), mesh=mesh,
+                       in_specs=P("dp"), out_specs=P(), check=True))
         dt = measure(psum_fn, sharded, args.iters)
         psum = mesh_bytes / dt / 1e9
 
         perm = [(i, (i + 1) % n) for i in range(n)]
         pp_fn = jax.jit(
-            jax.shard_map(lambda x: jax.lax.ppermute(x, "dp", perm),
-                          mesh=mesh, in_specs=P("dp"), out_specs=P("dp")))
+            _shard_map(lambda x: jax.lax.ppermute(x, "dp", perm),
+                       mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                       check=True))
         dt = measure(pp_fn, sharded, args.iters)
         pperm = mesh_bytes / dt / 1e9
 

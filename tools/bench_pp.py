@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Pipeline-parallel (pp) training benchmark: closed-loop fused-step
+throughput on the 8-device CPU mesh, sweeping the microbatch count,
+against the dp-only baseline on the SAME devices.
+
+Prints ONE JSON line (the `bench.py` convention):
+
+  {"metric": "pp_train_throughput", "value": <best samples/s>,
+   "unit": "samples/s", "dp": N, "tp": N, "pp": N,
+   "baseline_dp_only_samples_s": N, "weights_match": true,
+   "sweep": [{"microbatches": M, "samples_s": N, "ms_per_step": N,
+              "bubble_fraction": B, "ticks": T, "vs_dp_only": R}, ...]}
+
+Methodology (PERF.md appendix "Pipeline parallelism"):
+- Model: residual-MLP trunk of BENCH_PP_LAYERS uniform __pp_block__
+  blocks at BENCH_PP_HIDDEN width (the pp.split_blocks contract;
+  models/transformer.py ships the same annotations for the LM).
+- pp run: MeshPlan(dp=dp, tp=BENCH_PP_TP, pp=BENCH_PP_PP) —
+  the mxnet_tpu.pp interleaved-1F1B pipeline inside the ONE fused
+  program, per-microbatch grad accumulation, ZeRO-1 over 'dp'.
+- baseline: MeshPlan over the same 8 devices with dp=8 (no tp/pp),
+  same global batch, ONE whole-batch fused step.
+- bubble_fraction: the schedule-table idle fraction, exactly
+  (pp−1)/(M+pp−1) for the packed 1F1B/GPipe flush — the acceptance
+  gate asserts < 1/M × (pp−1) × 1.25 at M=8.
+- weights_match: N fused steps of the pp run against the dp-only run
+  from identical init agree to 2e-4/2e-5 (fp reassociation of the
+  microbatch sum is the only permitted difference).
+
+Env knobs: BENCH_PP_LAYERS (8), BENCH_PP_HIDDEN (256), BENCH_PP_BATCH
+(64), BENCH_PP_MICRO ("1,2,4,8"), BENCH_PP_PP (2), BENCH_PP_TP (1),
+BENCH_PP_STEPS (8), BENCH_PP_WARMUP (2), BENCH_PP_DEVICES (8).
+"""
+
+import json
+import os
+import sys
+import time
+
+_DEV = int(os.environ.get("BENCH_PP_DEVICES", "8"))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count={_DEV}").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import parallel, pp  # noqa: E402
+
+LAYERS = int(os.environ.get("BENCH_PP_LAYERS", "8"))
+HIDDEN = int(os.environ.get("BENCH_PP_HIDDEN", "256"))
+BATCH = int(os.environ.get("BENCH_PP_BATCH", "64"))
+MICRO = [int(m) for m in os.environ.get("BENCH_PP_MICRO", "1,2,4,8").split(",")]
+PP = int(os.environ.get("BENCH_PP_PP", "2"))
+TP = int(os.environ.get("BENCH_PP_TP", "1"))
+STEPS = int(os.environ.get("BENCH_PP_STEPS", "8"))
+WARMUP = int(os.environ.get("BENCH_PP_WARMUP", "2"))
+
+RULES = (("hidden", "tp"), ("embed", None))
+
+
+def _sym():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(
+        data, num_hidden=HIDDEN, name="inproj",
+        weight=mx.sym.Variable("inproj_weight",
+                               attr=parallel.logical_axes("hidden",
+                                                          "embed")))
+    for i in range(LAYERS):
+        with mx.AttrScope(__pp_block__=str(i)):
+            h = mx.sym.FullyConnected(net, num_hidden=HIDDEN,
+                                      name=f"blk{i}_fc")
+            net = net + mx.sym.Activation(h, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="head")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _module(plan):
+    mx.random.seed(11)
+    mod = mx.mod.Module(_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (BATCH, HIDDEN))],
+             label_shapes=[("softmax_label", (BATCH,))],
+             for_training=True)
+    mod.init_params(mx.initializer.Uniform(0.05))
+    if plan is not None:
+        mod.set_mesh_plan(plan)
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    return mod
+
+
+def _run_steps(mod, n, collect=False):
+    """Closed-loop fused steps on fresh synthetic batches."""
+    rng = np.random.RandomState(5)
+    for i in range(n):
+        X = rng.randn(BATCH, HIDDEN).astype(np.float32)
+        y = rng.randint(0, 16, size=BATCH).astype(np.float32)
+        b = mx.io.DataBatch(data=[mx.nd.array(X)],
+                            label=[mx.nd.array(y)])
+        mod.forward_backward(b)
+        mod.update()
+    import jax
+
+    jax.block_until_ready(
+        [mod._exec.arg_dict[n_]._data for n_ in mod._grad_param_names])
+    if collect:
+        args, _ = mod.get_params()
+        return {k: np.asarray(mx.nd.gather_global(v))
+                for k, v in args.items()}
+    return None
+
+
+def _bench(plan):
+    mod = _module(plan)
+    _run_steps(mod, WARMUP)  # compile + settle
+    t0 = time.perf_counter()
+    _run_steps(mod, STEPS)
+    dt = (time.perf_counter() - t0) / STEPS
+    return mod, dt
+
+
+def main():
+    import jax
+
+    n = len(jax.devices())
+    dp = n // (PP * TP)
+
+    # dp-only baseline on the same devices
+    base_plan = parallel.MeshPlan(jax.devices(), dp=n, rules=RULES)
+    _, base_dt = _bench(base_plan)
+    base_sps = BATCH / base_dt
+
+    # equivalence proof: pp weights == dp-only weights from same init
+    ref = _run_steps(_module(base_plan), 4, collect=True)
+    eq_plan = parallel.MeshPlan(jax.devices(), dp=dp, tp=TP, pp=PP,
+                                microbatches=max(2, PP), rules=RULES)
+    got = _run_steps(_module(eq_plan), 4, collect=True)
+    match = all(np.allclose(ref[k], got[k], rtol=2e-4, atol=2e-5)
+                for k in ref)
+
+    sweep = []
+    dropped = [m for m in MICRO if BATCH % (dp * m)]
+    if dropped:
+        print(f"note: dropping microbatch counts {dropped} — batch "
+              f"{BATCH} not divisible by dp({dp}) x m", file=sys.stderr)
+    for m in MICRO:
+        if BATCH % (dp * m):
+            continue
+        plan = parallel.MeshPlan(jax.devices(), dp=dp, tp=TP, pp=PP,
+                                 microbatches=m, rules=RULES)
+        mod, dt = _bench(plan)
+        sched = mod._pp_schedule
+        sweep.append({
+            "microbatches": m,
+            "samples_s": round(BATCH / dt, 2),
+            "ms_per_step": round(dt * 1e3, 3),
+            "bubble_fraction": round(sched.bubble_fraction, 5),
+            "ticks": int(sched.num_ticks),
+            "vs_dp_only": round((BATCH / dt) / base_sps, 3),
+        })
+
+    best = max((row["samples_s"] for row in sweep), default=0.0)
+    out = {
+        "metric": "pp_train_throughput",
+        "value": best,
+        "unit": "samples/s",
+        "dp": dp, "tp": TP, "pp": PP,
+        "layers": LAYERS, "hidden": HIDDEN, "batch": BATCH,
+        "steps": STEPS,
+        "schedule": os.environ.get("MXNET_PP_SCHEDULE", "1f1b"),
+        "baseline_dp_only_samples_s": round(base_sps, 2),
+        "weights_match": bool(match),
+        "sweep": sweep,
+    }
+    print(json.dumps(out))
+    if not match:
+        raise SystemExit("pp and dp-only training diverged")
+    # every swept row is gated against its own bound — no silent skip
+    # (pp=1 has no pipeline and a zero bubble by construction)
+    bad = [r for r in sweep
+           if PP > 1 and not r["bubble_fraction"]
+           < (1 / r["microbatches"]) * (PP - 1) * 1.25]
+    if bad:
+        raise SystemExit(f"bubble fraction over the 1F1B bound: {bad}")
+    if not sweep:
+        raise SystemExit("empty sweep: no requested microbatch count "
+                         f"divides batch {BATCH} over dp={dp}")
+
+
+if __name__ == "__main__":
+    main()

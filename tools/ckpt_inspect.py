@@ -78,6 +78,7 @@ def cmd_manifest(args):
     meta = {k: state[k] for k in
             ("step", "epoch", "nbatch", "rank", "num_shards", "reason")}
     meta["wall_time"] = state.get("wall_time")
+    meta["mesh"] = state.get("mesh")  # dp/tp/pp layout that wrote it
     print(json.dumps({"checkpoint": info.path, "meta": meta}, indent=1,
                      default=str))
     print("arg_params:")
