@@ -931,6 +931,9 @@ class CheckpointManager:
     def _on_signal(self, signum, frame):
         self.logger.warning("[ckpt] signal %d: emergency checkpoint "
                             "requested", signum)
+        # flight recorder first: the ring dump is tiny and read-only,
+        # and must land even if the emergency save itself dies
+        _prof.dump_flight_record("sigterm", extra={"signum": signum})
         self._preempted = True
         if not self._in_step and not self._in_rollback:
             self._emergency_exit()

@@ -369,7 +369,11 @@ class CommScheduler:
         try:
             handle.wait(timeout)
         finally:
-            self.blocked_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.blocked_s += dt
+            # goodput decomposition: blocked-on-comm seconds drain
+            # into the next fit-step sample as its "comm" slice
+            _prof.goodput_tracker().add_comm(dt)
 
     def drain(self, timeout: float = 630.0):
         """Flush and wait for EVERY outstanding bucket (barrier /
@@ -382,7 +386,9 @@ class CommScheduler:
             for h in pending:
                 h.wait(timeout)
         finally:
-            self.blocked_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.blocked_s += dt
+            _prof.goodput_tracker().add_comm(dt)
         with self._cond:
             self._outstanding = [h for h in self._outstanding
                                  if not h.done]

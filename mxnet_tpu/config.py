@@ -421,6 +421,52 @@ register_env(
     "swapped stay swapped).  Must be >= 0.1; garbage raises at Router "
     "construction.")
 register_env(
+    "MXNET_METRICS_PORT", 0, int,
+    "Port of the per-process ops HTTP endpoint serving /metrics "
+    "(Prometheus text), /statusz (JSON: gauges, goodput/MFU, serving "
+    "and router stats, membership epoch) and /tracez (flight-recorder "
+    "snapshot).  0/unset (default): disabled.  Serving engines, the "
+    "fleet Router and Module.fit auto-start it when set; fleet replica "
+    "processes always bind an EPHEMERAL port instead and publish it in "
+    "<fleet_dir>/mz_<rid> (tools/fleet_top.py polls those).  Binds "
+    "loopback only; garbage values raise at server start.")
+register_env(
+    "MXNET_FLIGHT_RECORDER", 1, int,
+    "1 (default): every span/event/metric sample also lands in a "
+    "bounded in-memory ring (the crash flight recorder) — dumped to a "
+    "post-mortem JSON on DeadRankError, replica conviction, ShedError "
+    "bursts, SIGTERM and engine/serving-loop crashes.  No file I/O in "
+    "steady state.  0: off (spans revert to profiler-only).")
+register_env(
+    "MXNET_FLIGHT_RECORDER_SIZE", 4096, int,
+    "Flight-recorder ring capacity in EVENTS (default 4096 ≈ the last "
+    "few seconds of a busy serving loop).  Values < 16 or garbage "
+    "raise at first record.")
+register_env(
+    "MXNET_FLIGHT_RECORDER_DIR", None, str,
+    "Directory for flight-recorder artifacts.  When set, the ring "
+    "ALSO write-throughs into a memory-mapped ring file "
+    "(flight_rank<R>_pid<P>.ring) whose pages the OS flushes after "
+    "process death — a kill -9'd process still leaves its last-N-"
+    "seconds record (tools/trace_merge.py reads it).  Post-mortem "
+    "JSON dumps (flightdump_*.json) land here too; unset: dumps go "
+    "to <tmpdir>/mxnet_tpu_flight and no ring file is kept.")
+register_env(
+    "MXNET_TRACE_SAMPLE", 1.0, float,
+    "Fraction of fleet requests that get a root distributed-trace "
+    "context (W3C-traceparent-style ids propagated client → router → "
+    "replica → engine; see README 'Observability').  1.0 (default): "
+    "trace everything; 0: tracing off.  The per-request decision is "
+    "deterministic in the ticket id, so retries keep their verdict.  "
+    "Out-of-range or garbage values raise at first use.")
+register_env(
+    "MXNET_PEAK_TFLOPS", None, float,
+    "Per-chip peak dense-matmul TFLOP/s for the training.mfu gauge "
+    "denominator.  Unset: a built-in table keyed on the jax device "
+    "kind (TPU v4/v5e/v5p/v6); REQUIRED for MFU on CPU meshes and "
+    "unlisted hardware (the gauge is withheld rather than guessed).  "
+    "Non-positive or garbage values raise at first use.")
+register_env(
     "MXNET_TEST_DEVICE", None, str,
     "Device the test utilities bind to (test_utils.default_context; "
     "the reference's MXNET_TEST_DEVICE).  Unset: the ambient current "

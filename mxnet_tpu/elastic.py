@@ -198,6 +198,18 @@ class DeadRankError(MXNetError):
             msg += f": {detail}"
         super().__init__(msg)
 
+    def dump_flight_record(self):
+        """Dump this process's flight-recorder ring for the verdict.
+        Called where the verdict is ACTED on (fit's recovery path) —
+        not in the constructor, so merely building the exception (a
+        test asserting its message) does no file I/O."""
+        from . import profiler
+
+        return profiler.dump_flight_record(
+            "dead_rank", extra={"dead_ranks": self.dead_ranks,
+                                "epoch": self.epoch,
+                                "detail": str(self)})
+
 
 def _atomic_write_json(path: str, obj: Dict) -> None:
     from .checkpoint import atomic_write_bytes
@@ -293,6 +305,11 @@ class Membership:
                 rec = json.load(f)
         except (OSError, ValueError):
             return None
+        # the /statusz membership view: every reader keeps the gauge
+        # current, so a fleet table shows which epoch each process is on
+        from . import profiler
+
+        profiler.set_gauge("elastic.epoch", float(rec.get("epoch", n)))
         return rec
 
     def bootstrap(self, active: Sequence[int], world: int,

@@ -89,6 +89,11 @@ _NO_EOS = -(1 << 62)
 
 _log = logging.getLogger("mxnet_tpu.fleet")
 
+# ShedError-burst flight-recorder trigger: this many sheds inside the
+# window = one post-mortem dump (rate-limited in dump_flight_record)
+_SHED_BURST_COUNT = 32
+_SHED_BURST_WINDOW_S = 10.0
+
 
 class ShedError(MXNetError):
     """Typed admission-control rejection: the router determined this
@@ -378,14 +383,23 @@ class ReplicaServer:
         (rid,) = wire.U64.unpack_from(buf, 1)
         if op == _F_SUBMIT:
             try:
-                spec = _unpack_spec(buf, 9)
+                # optional trace field first (PR 12): the router's
+                # span becomes the parent of this replica's spans
+                trace, off = wire.unpack_trace(buf, 9)
+                if trace is not None:
+                    profiler.trace_point(
+                        "wire.recv", trace.child(), cat="fleet",
+                        args={"rid": self.rid})
+                spec = _unpack_spec(buf, off)
                 if spec["kind"] == "infer":
-                    fut = self.harness.submit_infer(spec["inputs"])
+                    fut = self.harness.submit_infer(spec["inputs"],
+                                                    trace=trace)
                 else:
                     fut = self.harness.submit_decode(
                         spec["prompt"], spec["max_new"],
                         temperature=spec["temperature"],
-                        eos_id=spec["eos"], seed=spec["seed"])
+                        eos_id=spec["eos"], seed=spec["seed"],
+                        trace=trace)
             except BaseException as exc:  # noqa: BLE001 — to the wire
                 self._send(sock, wlock, _F_RESULT, rid, _ST_ERR,
                            f"{type(exc).__name__}: {exc}".encode())
@@ -404,8 +418,9 @@ class ReplicaServer:
             return
         if op == _F_CTRL:
             try:
+                _trace, off = wire.unpack_trace(buf, 9)
                 spec, _ = wire.unpack_signed_json(
-                    self._secret, buf, 9, "fleet control frame")
+                    self._secret, buf, off, "fleet control frame")
             except BaseException as exc:  # noqa: BLE001 — to the wire
                 self._send(sock, wlock, _F_CTRL_RESULT, rid, _ST_ERR,
                            f"{type(exc).__name__}: {exc}".encode())
@@ -492,8 +507,20 @@ class ReplicaClient:
         return self._dx.dead
 
     def submit(self, spec: Dict[str, Any]) -> Future:
-        return self._dx.begin(_F_SUBMIT, _pack_spec(spec),
-                              _parse_submit_response)
+        # "trace" is router metadata, not request payload: it rides
+        # the frame's optional trace field, never the spec encoding
+        trace = spec.get("trace")
+        if trace is not None:
+            spec = {k: v for k, v in spec.items() if k != "trace"}
+        body = wire.pack_trace(trace) + _pack_spec(spec)
+        t0 = time.perf_counter()
+        fut = self._dx.begin(_F_SUBMIT, body, _parse_submit_response)
+        if trace is not None:
+            profiler.add_trace_event(
+                "wire.send", t0, time.perf_counter() - t0,
+                trace.child(), cat="fleet",
+                args={"rid": self.rid, "bytes": len(body)})
+        return fut
 
     def _ctrl(self, obj: Dict, timeout: float = 120.0) -> Dict:
         def parse(status, payload):
@@ -501,7 +528,8 @@ class ReplicaClient:
                 return MXNetError(bytes(payload).decode(errors="replace"))
             return json.loads(bytes(payload).decode())
 
-        body = wire.pack_signed_json(self._secret, obj)
+        body = wire.pack_trace(None) \
+            + wire.pack_signed_json(self._secret, obj)
         return self._dx.begin(_F_CTRL, body, parse).result(timeout)
 
     def inflight(self) -> int:
@@ -603,6 +631,11 @@ def _replica_main(spec: Dict) -> int:
 
     rid = int(spec["rid"])
     fleet_dir = spec["fleet_dir"]
+    # flight recorder: point the mmap ring file at the shared fleet
+    # dir (unless the operator chose one) so a kill -9'd replica's
+    # last-N-seconds record survives WHERE THE DRILL LOOKS
+    if not os.environ.get("MXNET_FLIGHT_RECORDER_DIR"):
+        profiler.init_flight_recorder(fleet_dir)
     mod_name, _, fn_name = spec["builder"].partition(":")
     import importlib
 
@@ -624,6 +657,18 @@ def _replica_main(spec: Dict) -> int:
                            secret=read_secret(fleet_dir))
     atomic_write_bytes(os.path.join(fleet_dir, f"ep_{rid}"),
                        f"127.0.0.1:{server.port}".encode())
+    # ops endpoint: replicas always bind an EPHEMERAL port (N replicas
+    # on one host can't share MXNET_METRICS_PORT) and publish it as
+    # mz_<rid> — tools/fleet_top.py polls these /statusz endpoints
+    try:
+        mz = profiler.start_metrics_server(port=0)
+        profiler.register_statusz(
+            "replica", lambda: {"rid": rid, "pid": os.getpid(),
+                                "port": server.port})
+        atomic_write_bytes(os.path.join(fleet_dir, f"mz_{rid}"),
+                           f"127.0.0.1:{mz.port}".encode())
+    except Exception:  # noqa: BLE001 — ops surface must not kill serving
+        pass
     _log.warning("[fleet] replica %d serving on :%d (pid %d)",
                  rid, server.port, os.getpid())
     parent = int(spec.get("parent", 0))
@@ -646,9 +691,10 @@ class _Ticket:
 
     __slots__ = ("tid", "spec", "deadline", "units", "attempts",
                  "rid", "t_submit", "t_dispatch", "future", "delivered",
-                 "queued")
+                 "queued", "trace", "t_enqueue", "tp_submit",
+                 "tp_dispatch", "trace_owned")
 
-    def __init__(self, tid, spec, deadline, units, future):
+    def __init__(self, tid, spec, deadline, units, future, trace=None):
         self.tid = tid
         self.spec = spec
         self.deadline = deadline      # absolute monotonic, or None
@@ -660,6 +706,13 @@ class _Ticket:
         self.future = future          # resolves toward the client
         self.delivered = False        # retired: exactly-once latch
         self.queued = True            # sitting in Router._pending
+        self.trace = trace            # TraceContext | None
+        # perf_counter twins of the monotonic stamps — span timestamps
+        # share the clock every other span in the process uses
+        self.tp_submit = time.perf_counter()
+        self.t_enqueue = self.tp_submit  # (re)joined the queue
+        self.tp_dispatch = 0.0
+        self.trace_owned = False  # router created the root span
 
 
 class _ReplicaState:
@@ -743,6 +796,10 @@ class Router:
         self._pending: List[_Ticket] = []
         self._next_tid = 0
         self._alive = True
+        import collections as _collections
+
+        self._shed_times = _collections.deque(maxlen=_SHED_BURST_COUNT)
+        self._last_shed_dump = 0.0
         self._swap_lock = threading.Lock()  # one rolling swap at a time
         self._weights_step = -1
 
@@ -763,6 +820,10 @@ class Router:
             name="mxnet_tpu-fleet-monitor")
         self._monitor.start()
         self._set_alive_gauge()
+        # ops surface: /statusz grows a router section; the HTTP
+        # endpoint itself is MXNET_METRICS_PORT-gated
+        profiler.maybe_start_metrics_server()
+        profiler.register_statusz("router", self.stats)
 
     # -- metrics --------------------------------------------------------
     def _count(self, name, value=1.0):
@@ -775,17 +836,21 @@ class Router:
             sum(not s.dead for s in self._replicas.values()))
 
     # -- client surface -------------------------------------------------
-    def submit(self, inputs, deadline_ms: Optional[float] = None) -> Future:
+    def submit(self, inputs, deadline_ms: Optional[float] = None,
+               trace=None) -> Future:
         """Route one inference request; the Future resolves to the list
         of output arrays (or raises :class:`ShedError` /
-        the replica's error)."""
+        the replica's error).  ``trace``: the caller's
+        :class:`profiler.TraceContext` (the served wire passes the
+        client's through); None = a sampled root context."""
         return self._accept({"kind": "infer", "inputs": dict(inputs)},
                             deadline_ms,
-                            units=self._infer_units(inputs))
+                            units=self._infer_units(inputs),
+                            trace=trace)
 
     def generate(self, prompt, max_new_tokens=32, temperature=None,
                  eos_id=None, deadline_ms: Optional[float] = None,
-                 seed: Optional[int] = None) -> Future:
+                 seed: Optional[int] = None, trace=None) -> Future:
         """Route one generation; the Future resolves to the np.int32
         generated tokens."""
         spec = {"kind": "decode",
@@ -793,7 +858,7 @@ class Router:
                 "max_new": int(max_new_tokens), "temperature": temperature,
                 "eos": eos_id, "seed": 0}
         return self._accept(spec, deadline_ms, units=int(max_new_tokens),
-                            seed=seed)
+                            seed=seed, trace=trace)
 
     @staticmethod
     def _infer_units(inputs) -> int:
@@ -802,7 +867,8 @@ class Router:
             return max(1, int(shape[0]) if len(shape) else 1)
         return 1
 
-    def _accept(self, spec, deadline_ms, units, seed=None) -> Future:
+    def _accept(self, spec, deadline_ms, units, seed=None,
+                trace=None) -> Future:
         fut: Future = Future()
         with self._cond:
             if not self._alive:
@@ -820,7 +886,15 @@ class Router:
                             + self._default_deadline_s)
             else:
                 deadline = time.monotonic() + float(deadline_ms) / 1e3
-            t = _Ticket(tid, spec, deadline, max(1, units), fut)
+            owned = False
+            if trace is None:
+                # direct (in-process) callers get a sampled root; the
+                # tid key keeps the verdict stable across retries
+                trace = profiler.make_trace(key=tid)
+                owned = trace is not None
+            t = _Ticket(tid, spec, deadline, max(1, units), fut,
+                        trace=trace)
+            t.trace_owned = owned
             self._pending.append(t)
             profiler.set_gauge("fleet.pending", len(self._pending))
             self._cond.notify_all()
@@ -965,6 +1039,23 @@ class Router:
                     t.rid = state.handle.rid
                     t.attempts += 1
                     t.t_dispatch = time.monotonic()
+                    now_p = t.tp_dispatch = time.perf_counter()
+                    wait_ms = (now_p - t.t_enqueue) * 1e3
+                    self._metrics.observe("queue_wait_ms", wait_ms)
+                    profiler.observe("fleet.queue_wait_ms", wait_ms)
+                    if t.attempts == 1:
+                        # admission latency: submit → first dispatch
+                        # (eligibility + depth gating, incl. queue)
+                        adm = (now_p - t.tp_submit) * 1e3
+                        self._metrics.observe("admission_ms", adm)
+                        profiler.observe("fleet.admission_ms", adm)
+                    if t.trace is not None:
+                        profiler.add_trace_event(
+                            "router.queue", t.t_enqueue,
+                            now_p - t.t_enqueue, t.trace.child(),
+                            cat="fleet",
+                            args={"tid": t.tid, "attempt": t.attempts,
+                                  "rid": t.rid})
                     state.outstanding[t.tid] = t
                     profiler.set_gauge(
                         f"fleet.queue_depth.r{t.rid}",
@@ -977,6 +1068,11 @@ class Router:
                     # spinning the shed/assign scan at 100% CPU
                     self._cond.wait(timeout=0.05)
             for t, handle, attempt in todo:
+                # the replica sees the ticket's trace context as its
+                # parent ("trace" rides the spec to ReplicaClient,
+                # which ships it as the wire's optional field;
+                # in-process fakes just ignore the key)
+                t.spec["trace"] = t.trace
                 try:
                     rfut = handle.submit(t.spec)
                 except BaseException as exc:  # noqa: BLE001
@@ -991,10 +1087,60 @@ class Router:
         t.queued = False
         self._count("shed")
         self._count(f"shed_{reason}")
+        if t.trace is not None:
+            profiler.trace_point(
+                "router.shed", t.trace.child(), cat="fleet",
+                args={"tid": t.tid, "reason": reason})
+        self._note_shed()
         exc = ShedError(f"request shed ({reason}): {detail}",
                         reason=reason)
         if t.future.set_running_or_notify_cancel():
             t.future.set_exception(exc)
+
+    def _note_shed(self):
+        """Shed-burst detector: a storm of rejections is exactly the
+        moment to capture what the router was doing — one flight-
+        recorder dump per burst window.  Callers hold the router
+        condition lock, so only DETECT here; the dump (ring
+        serialization + file write) runs on a throwaway daemon thread
+        — blocking every submitter at peak overload would deepen the
+        very storm being recorded."""
+        now = time.monotonic()
+        self._shed_times.append(now)
+        if (len(self._shed_times) == self._shed_times.maxlen
+                and now - self._shed_times[0] <= _SHED_BURST_WINDOW_S
+                and now - self._last_shed_dump >= 2.0):
+            self._last_shed_dump = now
+            n = len(self._shed_times)
+            threading.Thread(
+                target=profiler.dump_flight_record,
+                args=("shed_burst",),
+                kwargs={"extra": {"sheds_in_window": n,
+                                  "window_s": _SHED_BURST_WINDOW_S}},
+                daemon=True,
+                name="mxnet_tpu-fleet-shed-dump").start()
+
+    def _requeue_retry_locked(self, t: _Ticket, rid_from, why: str):
+        """Front-of-queue requeue of a retried ticket; books the retry
+        histogram and the ``router.retry`` span — whose bounds ARE the
+        conviction window (failed dispatch → requeue), so a stitched
+        trace shows the dead replica's window explicitly."""
+        now_p = time.perf_counter()
+        t.t_enqueue = now_p
+        self._pending.insert(0, t)  # oldest first
+        self._count("retries")
+        if t.tp_dispatch:
+            retry_ms = (now_p - t.tp_dispatch) * 1e3
+            self._metrics.observe("retry_ms", retry_ms)
+            profiler.observe("fleet.retry_ms", retry_ms)
+            if t.trace is not None:
+                profiler.add_trace_event(
+                    "router.retry", t.tp_dispatch,
+                    now_p - t.tp_dispatch, t.trace.child(),
+                    cat="fleet",
+                    args={"tid": t.tid, "attempt": t.attempts,
+                          "from_rid": rid_from,
+                          "error": str(why)[:200]})
 
     # -- completion -----------------------------------------------------
     def _on_done(self, t: _Ticket, rfut: Future, attempt: int,
@@ -1049,8 +1195,7 @@ class Router:
                 if t.attempts <= self._retry_budget:
                     retry = True
                     t.queued = True
-                    self._pending.insert(0, t)  # oldest first
-                    self._count("retries")
+                    self._requeue_retry_locked(t, rid_disp, str(exc))
                 else:
                     t.delivered = True
             else:
@@ -1061,6 +1206,22 @@ class Router:
         lat_ms = (time.monotonic() - t.t_submit) * 1e3
         self._metrics.observe("latency_ms", lat_ms)
         profiler.observe("fleet.latency_ms", lat_ms)
+        if t.trace is not None:
+            now_p = time.perf_counter()
+            # the router-residency span (submit → delivery).  When the
+            # router MINTED the trace (no wire client upstream) this
+            # span IS the root — every queue/retry/replica span nests
+            # under it; with a FleetClient upstream it is a child of
+            # the client.request root instead.
+            profiler.add_trace_event(
+                "router.request", t.tp_submit, now_p - t.tp_submit,
+                t.trace if t.trace_owned else t.trace.child(),
+                cat="fleet",
+                args={"tid": t.tid, "attempts": t.attempts,
+                      "rid": t.rid, "ok": exc is None})
+            profiler.trace_point(
+                "router.deliver", t.trace.child(), cat="fleet",
+                args={"tid": t.tid, "ok": exc is None})
         if t.future.set_running_or_notify_cancel():
             if exc is None:
                 self._count("responses")
@@ -1132,8 +1293,7 @@ class Router:
             for t in orphans:
                 if t.attempts <= self._retry_budget:
                     t.queued = True
-                    self._pending.insert(0, t)
-                    self._count("retries")
+                    self._requeue_retry_locked(t, rid, exc)
                 else:
                     t.delivered = True
                     if t.future.set_running_or_notify_cancel():
@@ -1144,6 +1304,12 @@ class Router:
             self._cond.notify_all()
         profiler.del_gauge(f"fleet.queue_depth.r{rid}")
         self._set_alive_gauge()
+        # post-mortem: what the ROUTER saw in the seconds before the
+        # conviction (the dead replica's own ring file tells its side)
+        profiler.dump_flight_record(
+            "replica_conviction",
+            extra={"rid": rid, "error": str(exc),
+                   "retried": len(orphans)})
         try:
             state.handle.close()
         except Exception:  # noqa: BLE001 — already convicted
@@ -1235,7 +1401,24 @@ class Router:
         out["weights_step"] = self._weights_step
         out["cost_model_ms"] = {f"{k}:{b}": round(v, 3)
                                 for (k, b), v in sorted(self._cost.items())}
+        out["latency_breakdown"] = self.latency_breakdown()
         return out
+
+    def latency_breakdown(self) -> Dict:
+        """Router-side phase percentiles from the per-request spans'
+        histograms: queue_wait (per-dispatch pending wait), admission
+        (submit → first dispatch), retry (failed dispatch → requeue =
+        the conviction window), total (submit → delivery).  The
+        engines' stats() add prefill/decode; the benches merge both
+        into the JSON latency-breakdown object."""
+        from .serving import _phase_breakdown
+
+        return _phase_breakdown(
+            self._metrics.summary(),
+            {"queue_wait": "queue_wait_ms",
+             "admission": "admission_ms",
+             "retry": "retry_ms",
+             "total": "latency_ms"})
 
     def reset_stats(self):
         """Per-sweep-point percentiles for the bench (the DecodeEngine
@@ -1283,14 +1466,18 @@ class Router:
 
         if op == _F_SUBMIT:
             try:
-                # client SUBMIT carries a deadline budget before the
-                # request spec (0 = none → the router default applies)
-                (deadline_us,) = wire.U64.unpack_from(buf, 9)
+                # client SUBMIT: optional trace field, then a deadline
+                # budget, then the request spec (0 = none → the router
+                # default applies)
+                trace, off = wire.unpack_trace(buf, 9)
+                (deadline_us,) = wire.U64.unpack_from(buf, off)
+                off += 8
                 deadline_ms = deadline_us / 1e3 if deadline_us else None
-                spec = _unpack_spec(buf, 17)
+                spec = _unpack_spec(buf, off)
                 if spec["kind"] == "infer":
                     fut = self.submit(spec["inputs"],
-                                      deadline_ms=deadline_ms)
+                                      deadline_ms=deadline_ms,
+                                      trace=trace)
                 else:
                     # wire seed 0 = router-assigned (the deterministic
                     # ticket seed); explicit seeds pass through
@@ -1299,7 +1486,8 @@ class Router:
                         temperature=spec["temperature"],
                         eos_id=spec["eos"],
                         deadline_ms=deadline_ms,
-                        seed=spec["seed"] or None)
+                        seed=spec["seed"] or None,
+                        trace=trace)
             except ShedError as exc:
                 send(_F_RESULT, _ST_SHED, f"{exc.reason}: {exc}".encode())
                 return
@@ -1328,8 +1516,9 @@ class Router:
             # ctrl-thread rule)
             def ctrl():
                 try:
+                    _trace, off = wire.unpack_trace(buf, 9)
                     spec, _ = wire.unpack_signed_json(
-                        self._secret, buf, 9, "fleet control frame")
+                        self._secret, buf, off, "fleet control frame")
                     if spec.get("op") == "stats":
                         out = self.stats()
                     elif spec.get("op") == "swap":
@@ -1408,16 +1597,18 @@ class FleetClient:
         self._dx.start()
 
     def submit(self, inputs: Dict[str, Any],
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               trace=None) -> Future:
         spec = {"kind": "infer", "inputs": inputs}
-        return self._begin_submit(spec, deadline_ms)
+        return self._begin_submit(spec, deadline_ms, trace)
 
     def generate(self, prompt, max_new_tokens=32, temperature=None,
-                 eos_id=None, deadline_ms: Optional[float] = None) -> Future:
+                 eos_id=None, deadline_ms: Optional[float] = None,
+                 trace=None) -> Future:
         spec = {"kind": "decode", "prompt": prompt,
                 "max_new": max_new_tokens, "temperature": temperature,
                 "eos": eos_id, "seed": 0}
-        fut = self._begin_submit(spec, deadline_ms)
+        fut = self._begin_submit(spec, deadline_ms, trace)
         # decode result is ONE token tensor, not a list
         out: Future = Future()
 
@@ -1432,11 +1623,27 @@ class FleetClient:
         fut.add_done_callback(unwrap)
         return out
 
-    def _begin_submit(self, spec, deadline_ms) -> Future:
+    def _begin_submit(self, spec, deadline_ms, trace=None) -> Future:
         deadline_us = 0 if deadline_ms is None \
             else max(1, int(float(deadline_ms) * 1e3))
-        body = wire.U64.pack(deadline_us) + _pack_spec(spec)
-        return self._dx.begin(_F_SUBMIT, body, _parse_submit_response)
+        # the root of the distributed trace lives HERE: the client's
+        # submit→result span; everything the router and replicas stamp
+        # hangs under it via the wire's optional trace field
+        ctx = trace if trace is not None else profiler.make_trace()
+        body = (wire.pack_trace(ctx) + wire.U64.pack(deadline_us)
+                + _pack_spec(spec))
+        t0 = time.perf_counter()
+        fut = self._dx.begin(_F_SUBMIT, body, _parse_submit_response)
+        if ctx is not None:
+            def end_root(f, _t0=t0, _ctx=ctx):
+                profiler.add_trace_event(
+                    "client.request", _t0,
+                    time.perf_counter() - _t0, _ctx, cat="fleet",
+                    args={"kind": spec["kind"],
+                          "ok": f.exception() is None})
+
+            fut.add_done_callback(end_root)
+        return fut
 
     def stats(self) -> Dict:
         return self._ctrl({"op": "stats"})
@@ -1451,7 +1658,8 @@ class FleetClient:
                 return MXNetError(bytes(payload).decode(errors="replace"))
             return json.loads(bytes(payload).decode())
 
-        body = wire.pack_signed_json(self._secret, obj)
+        body = wire.pack_trace(None) \
+            + wire.pack_signed_json(self._secret, obj)
         return self._dx.begin(_F_CTRL, body, parse).result(timeout)
 
     def close(self):

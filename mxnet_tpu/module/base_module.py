@@ -221,6 +221,15 @@ class BaseModule:
         kv_obj = getattr(self, "_kvstore", None)
         self._fit_step_count = getattr(self, "_fit_step_count", 0)
 
+        # live efficiency accounting (PR 12): every loop iteration
+        # feeds the goodput tracker one wall decomposition sample —
+        # io-wait vs step vs checkpoint-blocking — and the fused step
+        # contributes its FLOPs (module.py) for the training.mfu
+        # gauge.  The MXNET_METRICS_PORT ops endpoint (if configured)
+        # makes all of it scrapeable DURING the fit.
+        _prof.maybe_start_metrics_server()
+        goodput = _prof.goodput_tracker()
+
         ################################################################
         # training loop (reference: base_module.py:404-449); a while
         # loop so an elastic rollback can REWIND epoch/nbatch to the
@@ -243,12 +252,14 @@ class BaseModule:
                 resume_nbatch = -1
             rolled_back = False
             while True:
+                t_io0 = time.perf_counter()
                 with _prof.scope("io.next", "io",
                                  args={"epoch": epoch, "step": nbatch}):
                     try:
                         data_batch = next(train_iter)
                     except StopIteration:
                         break
+                io_s = time.perf_counter() - t_io0
                 if monitor is not None:
                     monitor.tic()
                 if checkpoint is not None:
@@ -257,15 +268,22 @@ class BaseModule:
                     chaos.on_step(self._fit_step_count,
                                   rank=getattr(kv_obj, "rank", None))
                     self._fit_step_count += 1
+                    t_step0 = time.perf_counter()
                     with _prof.scope("fit.step", "step",
                                      args={"epoch": epoch, "step": nbatch}):
                         self.forward_backward(data_batch)
                         self.update()
+                    step_s = time.perf_counter() - t_step0
                     self.update_metric(eval_metric, data_batch.label)
+                    ckpt_s = 0.0
                     if checkpoint is not None:
+                        t_ck0 = time.perf_counter()
                         checkpoint.step_end(self, epoch=epoch,
                                             nbatch=nbatch,
                                             train_iter=train_data)
+                        ckpt_s = time.perf_counter() - t_ck0
+                    goodput.step(step_s, io_s=io_s, ckpt_s=ckpt_s)
+                    if checkpoint is not None:
                         admitted = self._elastic_admit(
                             kv_obj, checkpoint, elastic_data, elastic)
                         if admitted is not None:
@@ -344,6 +362,9 @@ class BaseModule:
         from ..base import MXNetError as _MXE
 
         _prof_mod.inc_counter("elastic.dead_rank_verdicts")
+        # the verdict IS the post-mortem moment: capture what this
+        # survivor was doing in the seconds before the death
+        dead.dump_flight_record()
         if checkpoint is None:
             raise _MXE(
                 "elastic recovery needs a CheckpointManager (pass "
@@ -410,6 +431,10 @@ class BaseModule:
             _skip_batches(train_data, state["nbatch"] + 1)
         _prof_mod.observe("elastic.recover_ms",
                           (time.time() - t0) * 1e3)
+        # goodput accounting: the whole re-mesh + rollback window is
+        # attributed LOST time (training.lost_s.remesh), so the
+        # goodput gauge keeps telling the truth across elastic events
+        _prof_mod.goodput_tracker().add_lost(time.time() - t0, "remesh")
         self.logger.warning(
             "[elastic] resumed at epoch %d batch %d (step %d) after "
             "%.2fs", state["epoch"], state["nbatch"] + 1, state["step"],

@@ -31,16 +31,51 @@ go, across every worker" question — Sigelman et al. 2010):
   adds p50/p90/p99 and per-counter rate-since-reset so the serving
   bench and the reporter share one schema.
 * exporters — ``prometheus_text()`` renders the registry in the
-  Prometheus text exposition format; ``start_reporter(path,
-  interval)`` appends a JSONL summary line every interval from a
-  daemon thread.
+  Prometheus text exposition format (real ``histogram``
+  ``_bucket``/``_sum``/``_count`` series since PR 12; the old
+  percentile flattening rides along as ``_p50``/``_p90``/``_p99``
+  gauges for one release); ``start_reporter(path, interval)`` appends
+  a JSONL summary line every interval from a daemon thread.
+
+The fleet-era additions (PR 12 — Dapper-style per-REQUEST accounting
+across processes, and the "what was this process doing when it died"
+question):
+
+* **trace context** — :class:`TraceContext` carries a W3C-traceparent-
+  style ``(trace_id, span_id, parent)`` triple; ``wire.py`` ships its
+  string form on fleet request/control frames, every tier stamps
+  child spans (``trace_span`` / ``add_trace_event``), and
+  ``tools/trace_merge.py`` stitches the per-process spans back into
+  one tree keyed by trace_id.
+* **flight recorder** — an always-on bounded in-memory ring of recent
+  spans/events/metric samples (``deque`` append: no locks, no file
+  I/O in steady state).  With ``MXNET_FLIGHT_RECORDER_DIR`` set the
+  ring ALSO write-throughs into a memory-mapped ring file — mmap
+  stores are plain memory writes, and the OS flushes the pages after
+  the process dies, so even a ``kill -9``'d replica leaves a readable
+  last-N-seconds record.  ``dump_flight_record(reason)`` writes the
+  post-mortem JSON; the engine/serving loops, replica conviction,
+  DeadRankError, shed bursts and the SIGTERM path call it.
+* **goodput / MFU** — :class:`GoodputTracker` turns per-step wall
+  samples (io-wait / step / comm / checkpoint-blocking) plus the
+  fused program's FLOPs into live ``training.mfu`` /
+  ``training.goodput`` gauges and a step-time decomposition that sums
+  to the wall clock; elastic recovery books its downtime as
+  attributed lost time.
+* **ops surface** — ``start_metrics_server`` serves ``/metrics``
+  (Prometheus text), ``/statusz`` (JSON: gauges + registered
+  providers) and ``/tracez`` (flight-recorder snapshot) from a tiny
+  stdlib HTTP server (``MXNET_METRICS_PORT``); ``tools/fleet_top.py``
+  polls ``/statusz`` across a fleet.
 """
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
+import struct
 import threading
 import time
 from contextlib import contextmanager
@@ -51,7 +86,13 @@ __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "observe", "metrics_summary", "reset_metrics", "set_gauge",
            "inc_gauge", "gauge_generation", "process_rank",
            "dump_rank_trace", "prometheus_text", "start_reporter",
-           "Reporter"]
+           "Reporter", "TraceContext", "trace_span", "add_trace_event",
+           "trace_point", "make_trace", "clock_anchor", "FlightRecorder",
+           "flight_recorder", "init_flight_recorder", "flight_snapshot",
+           "dump_flight_record", "read_flight_file", "GoodputTracker",
+           "goodput_tracker", "device_peak_flops", "MetricsServer",
+           "start_metrics_server", "maybe_start_metrics_server",
+           "register_statusz", "unregister_statusz", "statusz"]
 
 
 def process_rank() -> int:
@@ -100,7 +141,8 @@ class Profiler:
 
     # -- event recording -----------------------------------------------
     def add_event(self, name, start_s, dur_s, cat="op", tid=None, args=None):
-        if not self._running:
+        rec = _flight_if_enabled()
+        if not self._running and rec is None:
             return
         ev = {
             "name": name, "cat": cat, "ph": "X",
@@ -110,12 +152,18 @@ class Profiler:
         }
         if args:
             ev["args"] = dict(args)
-        with self._lock:
-            self._events.append(ev)
+        if self._running:
+            with self._lock:
+                self._events.append(ev)
+        if rec is not None:
+            rec.record(ev)
 
     def scope(self, name, cat="op", args=None):
-        # shared null context when off: zero allocation on the hot path
-        if not self._running:
+        # shared null context when BOTH the trace profiler and the
+        # flight recorder are off: zero allocation on the hot path.
+        # With the (always-on-by-default) flight recorder enabled the
+        # span is still timed and lands in the bounded ring only.
+        if not self._running and _flight_if_enabled() is None:
             return _NULL_CTX
         return self._span(name, cat, args)
 
@@ -191,11 +239,438 @@ def dump_rank_trace(trace_dir):
         trace_dir, f"trace_rank{process_rank()}.json"))
 
 
+def clock_anchor():
+    """The ONE clock-sync convention every timestamped artifact this
+    process writes shares: the same instant captured on ``time.time()``
+    (the NTP-shared wall clock) and ``time.perf_counter()`` (the clock
+    all event ``ts`` values are relative to).  ``Profiler.dump``,
+    :class:`Reporter` JSONL lines and flight-recorder dumps all embed
+    exactly this dict, so ``tools/trace_merge.py`` aligns all three
+    sources with one rule and zero per-tool skew heuristics."""
+    return {"wall_time_s": _profiler._wall0,
+            "perf_counter_s": _profiler._t0}
+
+
 def scope(name, cat="op", args=None):
     """Span context manager used by framework internals; no-op when
     off.  ``args`` (a small dict: step number, bytes, bucket key…)
     renders in the trace viewer."""
     return _profiler.scope(name, cat, args)
+
+
+# -- distributed trace context (the Dapper/W3C-traceparent story) --------
+class TraceContext:
+    """One request's identity across process boundaries.
+
+    ``trace_id`` (32 hex chars) names the REQUEST and never changes as
+    it hops client → router → replica → engine; ``span_id`` (16 hex)
+    names the current span; ``parent_id`` links it into the tree.  The
+    wire form is W3C-traceparent-style: ``00-<trace>-<span>-01`` —
+    ``wire.pack_trace`` ships it as an optional field on fleet
+    request/control frames, and the receiving tier's spans become
+    children of the sender's span (``from_header`` keeps the sender's
+    span_id so ``child()`` parents correctly)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id=None, span_id=None, parent_id=None):
+        self.trace_id = trace_id or os.urandom(16).hex()
+        self.span_id = span_id or os.urandom(8).hex()
+        self.parent_id = parent_id
+
+    def child(self) -> "TraceContext":
+        """A fresh span under this one, same trace."""
+        return TraceContext(self.trace_id, None, self.span_id)
+
+    def to_header(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @classmethod
+    def from_header(cls, header: str) -> "TraceContext":
+        """Parse a traceparent header.  The header's span_id becomes
+        THIS context's span_id, so spans the receiver opens via
+        :meth:`child` parent onto the sender's span — the cross-
+        process edge of the tree."""
+        parts = str(header).split("-")
+        if (len(parts) != 4 or len(parts[1]) != 32
+                or len(parts[2]) != 16):
+            raise ValueError(f"malformed traceparent {header!r}")
+        int(parts[1], 16), int(parts[2], 16)  # hex or raise
+        return cls(parts[1], parts[2], None)
+
+    def args(self):
+        a = {"trace_id": self.trace_id, "span_id": self.span_id}
+        if self.parent_id:
+            a["parent_span_id"] = self.parent_id
+        return a
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id[:8]}…/{self.span_id}"
+                f"<-{self.parent_id})")
+
+
+def _trace_sample_rate() -> float:
+    global _TRACE_SAMPLE
+    if _TRACE_SAMPLE is None:
+        raw = os.environ.get("MXNET_TRACE_SAMPLE")
+        if raw is None:
+            _TRACE_SAMPLE = 1.0
+        else:
+            try:
+                v = float(raw)
+            except ValueError:
+                raise _mx_error(
+                    f"MXNET_TRACE_SAMPLE={raw!r} is not a float in "
+                    "[0, 1] (fraction of requests that get a root "
+                    "trace context)")
+            if not 0.0 <= v <= 1.0:
+                raise _mx_error(
+                    f"MXNET_TRACE_SAMPLE={v} must be within [0, 1]")
+            _TRACE_SAMPLE = v
+    return _TRACE_SAMPLE
+
+
+_TRACE_SAMPLE = None
+
+
+def _mx_error(msg):
+    from .base import MXNetError
+
+    return MXNetError(msg)
+
+
+def make_trace(key=None):
+    """Root trace context for a new request, or ``None`` when sampled
+    out (``MXNET_TRACE_SAMPLE``, default 1.0 = trace everything).
+    ``key`` (e.g. a ticket id) makes the decision deterministic —
+    retries of the same request keep its sampling verdict."""
+    rate = _trace_sample_rate()
+    if rate >= 1.0:
+        return TraceContext()
+    if rate <= 0.0:
+        return None
+    if key is None:
+        key = int.from_bytes(os.urandom(4), "little")
+    # splitmix-style scramble: consecutive ids sample independently
+    h = (int(key) * 0x9E3779B97F4A7C15) & 0xFFFFFFFFFFFFFFFF
+    h ^= h >> 31
+    return TraceContext() if (h & 0xFFFFFF) / float(1 << 24) < rate \
+        else None
+
+
+def add_trace_event(name, start_s, dur_s, ctx, cat="trace", args=None):
+    """Record one span of ``ctx``'s trace (explicit timing — for spans
+    whose start/end live on different threads).  The trace ids ride in
+    the event args, which is what ``trace_merge.py``'s stitcher keys
+    on.  ``ctx`` None (sampled out) is a no-op."""
+    if ctx is None:
+        return
+    a = ctx.args()
+    if args:
+        a.update(args)
+    _profiler.add_event(name, start_s, dur_s, cat, args=a)
+
+
+def trace_point(name, ctx, args=None, cat="trace"):
+    """Zero-duration marker on ``ctx``'s trace (admission verdicts,
+    retry decisions, delivery)."""
+    add_trace_event(name, time.perf_counter(), 0.0, ctx, cat, args)
+
+
+@contextmanager
+def trace_span(name, parent, cat="trace", args=None):
+    """Open a CHILD span of ``parent`` around a code block; yields the
+    child context (pass it further down / across the wire).  With
+    ``parent`` None the block still runs, untraced."""
+    if parent is None:
+        yield None
+        return
+    ctx = parent.child()
+    start = time.perf_counter()
+    try:
+        yield ctx
+    finally:
+        add_trace_event(name, start, time.perf_counter() - start, ctx,
+                        cat, args)
+
+
+# -- flight recorder -----------------------------------------------------
+class FlightRecorder:
+    """Bounded ring of this process's recent spans/events/metric
+    samples — always on, no file I/O in steady state.
+
+    * In-memory: a ``deque(maxlen=capacity)`` of Chrome-trace-shaped
+      event dicts; appends are GIL-atomic (lock-free) and O(1), so
+      the hot path pays one dict build per span.
+    * Optional write-through ring FILE (``file_path``): a memory-
+      mapped fixed-size buffer the recorder memcpys each event's JSON
+      line into.  mmap stores are plain memory writes — no syscall —
+      and the kernel flushes the dirty pages when the process dies,
+      so a ``kill -9``'d process still leaves its last-N-seconds
+      record on disk (``read_flight_file`` /
+      ``tools/trace_merge.py`` recover it, skipping the torn line at
+      the wrap seam).
+
+    The file layout is ``MXFLTREC | u64 data-capacity | u64 total-
+    bytes-written | f64 wall0 | f64 t0 | u32 rank | u32 pid`` followed
+    by the data ring; the header's clock pair IS :func:`clock_anchor`,
+    so merged post-mortems align with live rank traces."""
+
+    MAGIC = b"MXFLTREC"
+    _HDR = struct.Struct("<8sQQddII")
+
+    def __init__(self, capacity=4096, file_path=None,
+                 file_bytes=1 << 20):
+        self._ring = collections.deque(maxlen=int(capacity))
+        self.capacity = int(capacity)
+        self._mm = None
+        self._file_lock = threading.Lock()
+        self._file_cap = 0
+        self._written = 0
+        self.file_path = None
+        if file_path:
+            try:
+                self._open_file(file_path, int(file_bytes))
+            except OSError:
+                self._mm = None  # memory ring still works
+
+    def _open_file(self, path, file_bytes):
+        import mmap
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        size = self._HDR.size + file_bytes
+        with open(path, "wb") as f:
+            f.truncate(size)
+        self._fh = open(path, "r+b")
+        self._mm = mmap.mmap(self._fh.fileno(), size)
+        anchor = clock_anchor()
+        self._HDR.pack_into(
+            self._mm, 0, self.MAGIC, file_bytes, 0,
+            anchor["wall_time_s"], anchor["perf_counter_s"],
+            process_rank(), os.getpid())
+        self._file_cap = file_bytes
+        self.file_path = path
+
+    def record(self, ev: dict):
+        """Append one Chrome-trace-shaped event; never raises."""
+        self._ring.append(ev)
+        if self._mm is None:
+            return
+        try:
+            line = json.dumps(ev, separators=(",", ":"),
+                              default=str).encode() + b"\n"
+            if len(line) > self._file_cap:
+                return
+            hdr = self._HDR.size
+            with self._file_lock:
+                pos = self._written % self._file_cap
+                first = min(len(line), self._file_cap - pos)
+                self._mm[hdr + pos:hdr + pos + first] = line[:first]
+                if first < len(line):  # wrap
+                    self._mm[hdr:hdr + len(line) - first] = line[first:]
+                self._written += len(line)
+                struct.pack_into("<Q", self._mm, 16, self._written)
+        except (ValueError, OSError):
+            pass
+
+    def snapshot(self, n=None):
+        evs = list(self._ring)
+        return evs if n is None else evs[-int(n):]
+
+    def sync(self):
+        """Flush the mmap ring to storage (dump time / tests only —
+        never on the record path)."""
+        if self._mm is not None:
+            try:
+                self._mm.flush()
+            except (ValueError, OSError):
+                pass
+
+    def close(self):
+        """Release the mmap/fd (recorder replacement); the in-memory
+        ring stays readable."""
+        mm, self._mm = self._mm, None
+        if mm is not None:
+            try:
+                mm.flush()
+                mm.close()
+                self._fh.close()
+            except (ValueError, OSError):
+                pass
+
+    def dump(self, reason: str, dir: str | None = None,
+             extra: dict | None = None) -> str:
+        """Write the post-mortem JSON: a Chrome-trace-compatible file
+        (``trace_merge.py`` consumes it directly) carrying the ring
+        snapshot, the shared clock anchor, a metrics summary, and the
+        ``reason``.  Returns the path."""
+        dir = dir or _flight_dir()
+        os.makedirs(dir, exist_ok=True)
+        path = os.path.join(
+            dir, f"flightdump_rank{process_rank()}_pid{os.getpid()}"
+                 f"_{reason}.json")
+        try:
+            metrics = metrics_summary()
+        except Exception:  # noqa: BLE001 — the dump must still land
+            metrics = {}
+        doc = {
+            "traceEvents": self.snapshot(),
+            "displayTimeUnit": "ms",
+            "metadata": {
+                "flight_recorder": True,
+                "reason": reason,
+                "rank": process_rank(),
+                "pid": os.getpid(),
+                "wall_time_s": time.time(),
+                "clock_sync": clock_anchor(),
+                "metrics": metrics,
+                **(extra or {}),
+            },
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, default=str)
+        os.replace(tmp, path)
+        self.sync()
+        return path
+
+
+def read_flight_file(path: str):
+    """Recover a (possibly kill -9 orphaned) mmap ring file → a
+    Chrome-trace dict with ``metadata.clock_sync``.  Torn lines at the
+    wrap seam are skipped.  (tools/trace_merge.py carries a standalone
+    copy of this logic so it needs no package import.)"""
+    with open(path, "rb") as f:
+        raw = f.read()
+    hdr = FlightRecorder._HDR
+    magic, cap, written, wall0, t0, rank, pid = hdr.unpack_from(raw, 0)
+    if magic != FlightRecorder.MAGIC:
+        raise ValueError(f"{path}: not a flight-recorder ring file")
+    data = raw[hdr.size:hdr.size + cap]
+    if written <= cap:
+        buf = data[:written]
+    else:
+        pos = written % cap
+        buf = data[pos:] + data[:pos]
+    events = []
+    for line in buf.split(b"\n"):
+        if not line.strip():
+            continue
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue  # torn at the seam / mid-write
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"flight_recorder": True, "rank": rank,
+                         "pid": pid,
+                         "clock_sync": {"wall_time_s": wall0,
+                                        "perf_counter_s": t0}}}
+
+
+_flight: FlightRecorder | None = None
+_flight_init_lock = threading.Lock()
+_FLIGHT_ENABLED: bool | None = None
+_flight_dumped: dict = {}  # reason -> last dump wall time (rate limit)
+
+
+def _flight_dir() -> str:
+    d = os.environ.get("MXNET_FLIGHT_RECORDER_DIR")
+    if d:
+        return d
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), "mxnet_tpu_flight")
+
+
+def _flight_if_enabled() -> FlightRecorder | None:
+    global _FLIGHT_ENABLED
+    if _FLIGHT_ENABLED is None:
+        _FLIGHT_ENABLED = \
+            os.environ.get("MXNET_FLIGHT_RECORDER", "1") != "0"
+    if not _FLIGHT_ENABLED:
+        return None
+    return flight_recorder()
+
+
+def _flight_capacity() -> int:
+    """Validated MXNET_FLIGHT_RECORDER_SIZE (event count)."""
+    raw = os.environ.get("MXNET_FLIGHT_RECORDER_SIZE")
+    try:
+        cap = int(raw) if raw else 4096
+    except ValueError:
+        raise _mx_error(
+            f"MXNET_FLIGHT_RECORDER_SIZE={raw!r} is not an integer "
+            "event count")
+    if cap < 16:
+        raise _mx_error(
+            f"MXNET_FLIGHT_RECORDER_SIZE={cap} must be >= 16")
+    return cap
+
+
+def flight_recorder() -> FlightRecorder:
+    """The process-global recorder (created lazily from
+    ``MXNET_FLIGHT_RECORDER_SIZE`` / ``_DIR``)."""
+    global _flight
+    if _flight is None:
+        with _flight_init_lock:
+            if _flight is None:
+                file_path = None
+                d = os.environ.get("MXNET_FLIGHT_RECORDER_DIR")
+                if d:
+                    file_path = os.path.join(
+                        d, f"flight_rank{process_rank()}"
+                           f"_pid{os.getpid()}.ring")
+                _flight = FlightRecorder(capacity=_flight_capacity(),
+                                         file_path=file_path)
+    return _flight
+
+
+def init_flight_recorder(dir=None, capacity=None,
+                         file_bytes=1 << 20) -> FlightRecorder:
+    """(Re)configure the global recorder explicitly — the fleet
+    replica main points the ring file at the shared fleet dir so the
+    kill -9 drill's post-mortems land where the drill looks.  A
+    previously-open ring file is closed, not leaked."""
+    global _flight, _FLIGHT_ENABLED
+    cap = capacity if capacity is not None else _flight_capacity()
+    path = None
+    if dir:
+        path = os.path.join(dir, f"flight_rank{process_rank()}"
+                                 f"_pid{os.getpid()}.ring")
+    with _flight_init_lock:
+        if _flight is not None:
+            _flight.close()
+        _flight = FlightRecorder(capacity=cap, file_path=path,
+                                 file_bytes=file_bytes)
+        _FLIGHT_ENABLED = True
+    return _flight
+
+
+def flight_snapshot(n=None):
+    """Recent flight-recorder events (the ``/tracez`` payload)."""
+    rec = _flight_if_enabled()
+    return rec.snapshot(n) if rec is not None else []
+
+
+def dump_flight_record(reason: str, dir=None, extra=None,
+                       min_interval_s: float = 2.0):
+    """Post-mortem dump trigger (DeadRankError, replica conviction,
+    engine-loop crash, shed burst, SIGTERM).  Rate-limited per reason
+    so a failure storm can't turn the recorder into a disk hog.
+    Returns the path, or None (disabled / rate-limited / dump
+    failed — a failing dump must never mask the original crash)."""
+    rec = _flight_if_enabled()
+    if rec is None:
+        return None
+    now = time.monotonic()
+    last = _flight_dumped.get(reason)
+    if last is not None and now - last < min_interval_s:
+        return None
+    _flight_dumped[reason] = now
+    try:
+        return rec.dump(reason, dir=dir, extra=extra)
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def add_event(name, start_s, dur_s, cat="op", args=None):
@@ -285,18 +760,33 @@ class MetricsRegistry:
         """Bumped by every reset(); see inc_gauge."""
         return self._gen
 
+    #: fixed Prometheus-histogram bucket upper bounds (ms-oriented but
+    #: generic — ratios land in the first bucket, minutes in the last;
+    #: +Inf is implicit = lifetime count).  Cumulated at export.
+    BUCKET_BOUNDS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                    100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                    10000.0, 30000.0, 60000.0)
+
     def observe(self, name, value):
+        import bisect
+
         with self._lock:
             h = self._hists.get(name)
             if h is None:
-                # (reservoir of last N, lifetime count, lifetime sum) —
-                # percentiles come from the reservoir, count/mean are
-                # exact over the full lifetime
+                # (reservoir of last N, lifetime count, lifetime sum,
+                # per-bucket counts) — percentiles come from the
+                # reservoir; count/mean and the Prometheus _bucket
+                # series are exact over the full lifetime
                 h = self._hists[name] = [
-                    self._deque(maxlen=self._reservoir), 0, 0.0]
-            h[0].append(float(value))
+                    self._deque(maxlen=self._reservoir), 0, 0.0,
+                    [0] * len(self.BUCKET_BOUNDS)]
+            v = float(value)
+            h[0].append(v)
             h[1] += 1
-            h[2] += float(value)
+            h[2] += v
+            i = bisect.bisect_left(self.BUCKET_BOUNDS, v)
+            if i < len(self.BUCKET_BOUNDS):
+                h[3][i] += 1
 
     def summary(self):
         """→ {'counters': {...}, 'rates': {name: per-second since
@@ -310,7 +800,8 @@ class MetricsRegistry:
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
-            hists = {k: (_np.asarray(h[0], dtype=_np.float64), h[1], h[2])
+            hists = {k: (_np.asarray(h[0], dtype=_np.float64), h[1],
+                         h[2], list(h[3]))
                      for k, h in self._hists.items()}
             elapsed = time.monotonic() - self._t_reset
         out = {"counters": counters,
@@ -319,7 +810,7 @@ class MetricsRegistry:
                "gauges": gauges,
                "histograms": {},
                "elapsed_s": elapsed}
-        for k, (vals, count, total) in hists.items():
+        for k, (vals, count, total, buckets) in hists.items():
             if not len(vals):
                 continue
             out["histograms"][k] = {
@@ -329,6 +820,9 @@ class MetricsRegistry:
                 "p50": float(_np.percentile(vals, 50)),
                 "p90": float(_np.percentile(vals, 90)),
                 "p99": float(_np.percentile(vals, 99)),
+                "sum": float(total),
+                # non-cumulative per-bound counts; exporters cumsum
+                "buckets": buckets,
             }
         return out
 
@@ -375,8 +869,18 @@ def gauge_generation():
 
 
 def observe(name, value):
-    """Record one histogram sample (e.g. ``serving.latency_ms``)."""
+    """Record one histogram sample (e.g. ``serving.latency_ms``).
+    Samples also land in the flight recorder as Chrome counter
+    events, so a post-mortem carries the metric timeline next to the
+    spans."""
     _metrics.observe(name, value)
+    rec = _flight_if_enabled()
+    if rec is not None:
+        rec.record({"name": name, "ph": "C",
+                    "ts": (time.perf_counter()
+                           - _profiler._t0) * 1e6,
+                    "pid": os.getpid(), "tid": 0,
+                    "args": {"value": float(value)}})
 
 
 def metrics_summary():
@@ -404,10 +908,18 @@ def prometheus_text(registry: MetricsRegistry | None = None,
     """Render the registry in the Prometheus text exposition format.
 
     Counters export as ``counter``, gauges as ``gauge``, histograms as
-    ``summary`` (p50/p90/p99 quantiles + _count/_sum).  Serve it from
-    any HTTP handler, or dump it periodically next to the JSONL
-    reporter — both views read the same registry, so ``serving.*``
-    counters and the training gauges show up with no extra wiring."""
+    REAL Prometheus ``histogram`` families — cumulative
+    ``_bucket{le=...}`` series over the fixed
+    :attr:`MetricsRegistry.BUCKET_BOUNDS` ladder plus exact
+    ``_sum``/``_count`` — so server-side ``histogram_quantile()``
+    works and histograms aggregate across ranks.  The previous
+    percentile flattening remains for one release as ``_p50``/
+    ``_p90``/``_p99`` gauges (README "Observability" notes the
+    rename).  Serve it from any HTTP handler (``/metrics`` via
+    :func:`start_metrics_server`), or dump it periodically next to
+    the JSONL reporter — both views read the same registry, so
+    ``serving.*`` counters and the training gauges show up with no
+    extra wiring."""
     summ = (registry or _metrics).summary()
     rank = process_rank()
     lines = []
@@ -422,11 +934,25 @@ def prometheus_text(registry: MetricsRegistry | None = None,
     for k in sorted(summ["histograms"]):
         h = summ["histograms"][k]
         m = f"{prefix}_{_prom_name(k)}"
-        lines.append(f"# TYPE {m} summary")
-        for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
-            lines.append(f'{m}{{rank="{rank}",quantile="{q}"}} {h[key]:g}')
+        lines.append(f"# TYPE {m} histogram")
+        cum = 0
+        for bound, n in zip(MetricsRegistry.BUCKET_BOUNDS,
+                            h.get("buckets", ())):
+            cum += n
+            lines.append(
+                f'{m}_bucket{{rank="{rank}",le="{bound:g}"}} {cum}')
+        lines.append(
+            f'{m}_bucket{{rank="{rank}",le="+Inf"}} {h["count"]}')
         lines.append(f'{m}_count{{rank="{rank}"}} {h["count"]}')
-        lines.append(f'{m}_sum{{rank="{rank}"}} {h["mean"] * h["count"]:g}')
+        lines.append(f'{m}_sum{{rank="{rank}"}} '
+                     f'{h.get("sum", h["mean"] * h["count"]):g}')
+        # deprecated compat series (one release): the old quantile
+        # flattening, renamed from <name>{quantile=...} to _pNN gauges
+        # so the histogram family above stays a valid exposition
+        for suffix, key in (("p50", "p50"), ("p90", "p90"),
+                            ("p99", "p99")):
+            lines.append(f"# TYPE {m}_{suffix} gauge")
+            lines.append(f'{m}_{suffix}{{rank="{rank}"}} {h[key]:g}')
     return "\n".join(lines) + "\n"
 
 
@@ -445,7 +971,11 @@ class Reporter:
         self._thread.start()
 
     def _write_line(self):
-        line = {"t": time.time(), "rank": process_rank()}
+        # clock_sync: the same anchor convention as Profiler.dump and
+        # the flight-recorder dumps, so trace_merge.py can align JSONL
+        # metric timelines with span timelines skew-free
+        line = {"t": time.time(), "rank": process_rank(),
+                "clock_sync": clock_anchor()}
         line.update(self._registry.summary())
         with open(self._path, "a") as f:
             f.write(json.dumps(line) + "\n")
@@ -473,6 +1003,384 @@ def start_reporter(path, interval=10.0, registry=None) -> Reporter:
     """Start a periodic JSONL metrics reporter; returns the handle
     (call ``.stop()`` to flush and join)."""
     return Reporter(path, interval=interval, registry=registry)
+
+
+# -- live goodput / MFU accounting ---------------------------------------
+# Known per-chip peak dense-matmul rates (bf16 FLOP/s) keyed by a
+# substring of the jax device description — the same numbers the
+# offline bench (tools/bench_secondary.py) divides by, promoted into
+# the library so a real `fit` exports the SAME MFU definition live.
+_PEAK_FLOPS_TABLE = (
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v6", 918e12),
+)
+
+
+def device_peak_flops():
+    """Per-chip peak FLOP/s for the MFU denominator:
+    ``MXNET_PEAK_TFLOPS`` (authoritative — required on CPU meshes and
+    unlisted hardware) or the built-in device table.  None = unknown →
+    the mfu gauge is simply not exported (goodput still is)."""
+    raw = os.environ.get("MXNET_PEAK_TFLOPS")
+    if raw is not None:
+        try:
+            v = float(raw)
+        except ValueError:
+            raise _mx_error(
+                f"MXNET_PEAK_TFLOPS={raw!r} is not a float (per-chip "
+                "peak TFLOP/s for MFU accounting)")
+        if v <= 0:
+            raise _mx_error(f"MXNET_PEAK_TFLOPS={v} must be > 0")
+        return v * 1e12
+    try:
+        import jax
+
+        desc = str(jax.devices()[0]).lower()
+    except Exception:  # noqa: BLE001 — no backend yet
+        return None
+    for token, flops in _PEAK_FLOPS_TABLE:
+        if token in desc:
+            return flops
+    return None
+
+
+class GoodputTracker:
+    """Live training-efficiency accounting: MFU, goodput, and a
+    step-time decomposition that sums to ~100% of wall time.
+
+    The fit loop feeds one sample per step (``step(step_s, io_s,
+    ckpt_s)``); the comm scheduler books its blocked-waiting seconds
+    via :meth:`add_comm`; the pipeline executor declares its static
+    bubble fraction; elastic recovery books re-mesh downtime via
+    :meth:`add_lost`.  Each step updates the gauges:
+
+    - ``training.mfu`` — flops_per_step / (EMA step seconds) / peak
+      (absent until both flops and peak are known);
+    - ``training.goodput`` — Σ productive step seconds ÷ wall seconds
+      since tracking started (lost time, io stalls and checkpoint
+      blocking all show up as the gap to 1.0);
+    - ``training.step_time_ms`` and ``training.frac_{compute, comm,
+      io_wait, pp_bubble, ckpt_block, other}`` — cumulative fractions
+      of wall, summing to 1 by construction;
+    - ``training.lost_s`` counter per ``add_lost`` reason
+      (``training.lost_s.<reason>``), surviving re-mesh events.
+    """
+
+    _EMA = 0.2  # step-seconds smoothing for the live mfu gauge
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self._lock = threading.Lock()
+        self._registry = registry  # None = the global gauge surface
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._t_start = None
+            self._t_last = None
+            self._wall_s = 0.0
+            self._flops = None
+            self._peak = None
+            self._peak_resolved = False
+            self._pp_bubble = 0.0
+            self._pending_comm = 0.0
+            self._steps = 0
+            self._step_s_ema = None
+            self._cum = {"compute": 0.0, "comm": 0.0, "io_wait": 0.0,
+                         "pp_bubble": 0.0, "ckpt_block": 0.0,
+                         "other": 0.0}
+            self._productive_s = 0.0
+            self._lost = {}
+
+    # -- configuration ---------------------------------------------------
+    def set_flops_per_step(self, flops):
+        """Model FLOPs of ONE optimizer step (fwd+bwd+update) — from
+        the fused program's XLA cost analysis (Module) or an analytic
+        formula (benches)."""
+        with self._lock:
+            self._flops = float(flops) if flops else None
+
+    def set_peak_flops(self, flops_per_s):
+        with self._lock:
+            self._peak = float(flops_per_s) if flops_per_s else None
+            self._peak_resolved = True
+
+    def set_pp_bubble(self, frac):
+        """Static pipeline-bubble fraction ((pp-1)/(M+pp-1)) of the
+        step — attributed out of compute in the decomposition."""
+        with self._lock:
+            self._pp_bubble = min(max(float(frac), 0.0), 1.0)
+
+    # -- attribution hooks -----------------------------------------------
+    def add_comm(self, seconds):
+        """Communication seconds the step blocked on (the comm
+        scheduler's wait paths); drained into the next step sample."""
+        with self._lock:
+            self._pending_comm += max(0.0, float(seconds))
+
+    def add_lost(self, seconds, reason: str):
+        """Attributed lost wall time (elastic re-mesh, rollback,
+        restore) — the goodput denominator keeps running through it,
+        and the per-reason counter says where it went."""
+        with self._lock:
+            self._lost[reason] = self._lost.get(reason, 0.0) \
+                + float(seconds)
+        inc_counter(f"training.lost_s.{reason}", float(seconds))
+
+    # -- per-step sample -------------------------------------------------
+    def step(self, step_s, io_s=0.0, ckpt_s=0.0):
+        """One training-loop iteration's wall decomposition: the
+        fit.step seconds, the io.next wait, the checkpoint blocking.
+        Everything between the previous sample and now that none of
+        those cover lands in ``other``."""
+        now = time.monotonic()
+        with self._lock:
+            if self._t_start is None:
+                self._t_start = now - (step_s + io_s + ckpt_s)
+                self._t_last = self._t_start
+            if not self._peak_resolved:
+                self._peak = device_peak_flops()
+                self._peak_resolved = True
+            # the wall this iteration accounts for: real elapsed since
+            # the previous sample, floored by what the caller claims
+            # happened (so synthetic/replayed samples stay consistent)
+            wall = max(now - self._t_last, step_s + io_s + ckpt_s)
+            self._wall_s += wall
+            self._t_last = now
+            comm = min(self._pending_comm, step_s)
+            self._pending_comm = 0.0
+            bubble = self._pp_bubble * max(step_s - comm, 0.0)
+            compute = max(step_s - comm - bubble, 0.0)
+            other = max(wall - step_s - io_s - ckpt_s, 0.0)
+            self._cum["compute"] += compute
+            self._cum["comm"] += comm
+            self._cum["pp_bubble"] += bubble
+            self._cum["io_wait"] += io_s
+            self._cum["ckpt_block"] += ckpt_s
+            self._cum["other"] += other
+            self._productive_s += step_s
+            self._steps += 1
+            self._step_s_ema = (
+                step_s if self._step_s_ema is None
+                else (1 - self._EMA) * self._step_s_ema
+                + self._EMA * step_s)
+            self._export_locked(now)
+
+    def _export_locked(self, now):
+        set_g = (self._registry.set_gauge if self._registry is not None
+                 else set_gauge)
+        wall = max(self._wall_s, 1e-9)
+        set_g("training.goodput", self._productive_s / wall)
+        set_g("training.step_time_ms", self._step_s_ema * 1e3)
+        set_g("training.steps", float(self._steps))
+        total = max(sum(self._cum.values()), 1e-9)
+        for k, v in self._cum.items():
+            set_g(f"training.frac_{k}", v / total)
+        if self._flops:
+            set_g("training.flops_per_step", self._flops)
+            if self._peak:
+                set_g("training.mfu",
+                      self._flops / max(self._step_s_ema, 1e-9)
+                      / self._peak)
+
+    def summary(self) -> dict:
+        """JSON-ready snapshot (the ``/statusz`` training section)."""
+        with self._lock:
+            if self._t_start is None:
+                return {"steps": 0}
+            wall = max(self._wall_s, 1e-9)
+            mean_step = self._productive_s / max(self._steps, 1)
+            out = {
+                "steps": self._steps,
+                "wall_s": wall,
+                "goodput": self._productive_s / wall,
+                "step_time_ms": mean_step * 1e3,
+                "step_time_ms_ema": (self._step_s_ema or 0.0) * 1e3,
+                "flops_per_step": self._flops,
+                "peak_flops": self._peak,
+                "mfu": (self._flops / max(mean_step, 1e-9) / self._peak
+                        if self._flops and self._peak else None),
+                "lost_s": dict(self._lost),
+            }
+            total = max(sum(self._cum.values()), 1e-9)
+            out["decomposition"] = {k: v / total
+                                    for k, v in self._cum.items()}
+            out["decomposition_s"] = dict(self._cum)
+            return out
+
+
+_goodput = GoodputTracker()
+
+
+def goodput_tracker() -> GoodputTracker:
+    """The process-global training-efficiency tracker (fit wires it)."""
+    return _goodput
+
+
+# -- ops surface: /metrics, /statusz, /tracez ----------------------------
+_statusz_providers: dict = {}
+_metrics_server = None
+_metrics_server_lock = threading.Lock()
+
+
+def register_statusz(name: str, fn):
+    """Contribute a section to ``/statusz``: ``fn()`` must return a
+    JSON-ready dict (called on the HTTP thread — must be thread-safe,
+    like the engines' ``stats()``)."""
+    _statusz_providers[str(name)] = fn
+
+
+def unregister_statusz(name: str):
+    _statusz_providers.pop(str(name), None)
+
+
+def statusz() -> dict:
+    """The ``/statusz`` document: process identity, uptime, the gauge
+    surface (goodput/MFU, cache_util, queue depths, membership epoch —
+    whatever the process exports), and every registered provider's
+    section (serving engine stats, router stats...)."""
+    summ = metrics_summary()
+    doc = {
+        "rank": process_rank(),
+        "pid": os.getpid(),
+        "wall_time_s": time.time(),
+        "clock_sync": clock_anchor(),
+        "gauges": summ["gauges"],
+        "counters": summ["counters"],
+        "training": _goodput.summary(),
+    }
+    for name, fn in sorted(_statusz_providers.items()):
+        try:
+            doc[name] = fn()
+        except Exception as exc:  # noqa: BLE001 — one bad provider
+            doc[name] = {"error": f"{type(exc).__name__}: {exc}"}
+    return doc
+
+
+class MetricsServer:
+    """Tiny stdlib HTTP server: ``/metrics`` (Prometheus text),
+    ``/statusz`` (JSON), ``/tracez`` (flight-recorder snapshot;
+    ``?n=`` bounds the event count).  Daemon threads; binds
+    loopback by default — expose it beyond the host through your own
+    proxy, it has no auth."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802 — stdlib name
+                pass
+
+            def do_GET(self):  # noqa: N802 — stdlib name
+                try:
+                    path, _, query = self.path.partition("?")
+                    if path == "/metrics":
+                        body = prometheus_text().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    elif path == "/statusz":
+                        body = json.dumps(statusz(),
+                                          default=str).encode()
+                        ctype = "application/json"
+                    elif path == "/tracez":
+                        n = 512
+                        for part in query.split("&"):
+                            if part.startswith("n="):
+                                try:
+                                    n = max(1, int(part[2:]))
+                                except ValueError:
+                                    pass
+                        body = json.dumps(
+                            {"rank": process_rank(),
+                             "pid": os.getpid(),
+                             "clock_sync": clock_anchor(),
+                             "traceEvents": flight_snapshot(n)},
+                            default=str).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except BrokenPipeError:
+                    pass
+
+        class Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, int(port)), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="mxnet_tpu-metrics-http")
+        self._thread.start()
+
+    def close(self):
+        global _metrics_server
+        self._server.shutdown()
+        self._server.server_close()
+        with _metrics_server_lock:
+            if _metrics_server is self:
+                _metrics_server = None
+
+
+def start_metrics_server(port: int | None = None,
+                         host: str = "127.0.0.1") -> MetricsServer:
+    """Start (or return) THE process metrics server.  ``port`` None
+    reads ``MXNET_METRICS_PORT`` (0/unset = refuse — use
+    :func:`maybe_start_metrics_server` for the env-gated autostart);
+    ``port=0`` binds an ephemeral port (the fleet-replica idiom — the
+    bound port is published via an endpoint file)."""
+    global _metrics_server
+    with _metrics_server_lock:
+        if _metrics_server is not None:
+            return _metrics_server
+        if port is None:
+            raw = os.environ.get("MXNET_METRICS_PORT")
+            try:
+                port = int(raw) if raw else 0
+            except ValueError:
+                raise _mx_error(
+                    f"MXNET_METRICS_PORT={raw!r} is not an integer "
+                    "port (0/unset disables the ops endpoint)")
+            if port <= 0:
+                raise _mx_error(
+                    "start_metrics_server(): no port given and "
+                    "MXNET_METRICS_PORT is unset/0")
+        if port < 0 or port > 65535:
+            raise _mx_error(f"metrics port {port} out of range")
+        _metrics_server = MetricsServer(port=port, host=host)
+        return _metrics_server
+
+
+def maybe_start_metrics_server():
+    """Env-gated idempotent autostart: a no-op unless
+    ``MXNET_METRICS_PORT`` names a positive port.  Called from the
+    serving engines, the fleet router, and ``fit`` so any process
+    under load is inspectable without code changes.  Returns the
+    server or None."""
+    raw = os.environ.get("MXNET_METRICS_PORT")
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        raise _mx_error(
+            f"MXNET_METRICS_PORT={raw!r} is not an integer port")
+    if port <= 0:
+        return None
+    try:
+        return start_metrics_server(port=port)
+    except OSError:
+        # the port is taken (a second process on this host with the
+        # same env): observability must never kill the workload
+        return None
 
 
 # -- XLA-level tracing (the per-kernel story) ---------------------------

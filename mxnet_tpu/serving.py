@@ -50,6 +50,22 @@ __all__ = ["InferenceEngine", "DecodeEngine", "EngineClosedError",
 _DEFAULT_BUCKETS = (1, 8, 32, 128)
 
 
+def _phase_breakdown(summ: dict, phases: Dict[str, str]) -> dict:
+    """Per-phase latency percentiles from a registry summary: the
+    ``latency_breakdown`` object the benches attach to their JSON so a
+    p99 regression names the phase (queue_wait / prefill / decode /
+    ...) instead of reporting one opaque number.  Phases with no
+    samples yet are omitted."""
+    out = {}
+    for phase, hist in phases.items():
+        h = summ["histograms"].get(hist)
+        if h:
+            out[phase] = {"p50_ms": round(h["p50"], 3),
+                          "p99_ms": round(h["p99"], 3),
+                          "count": h["count"]}
+    return out
+
+
 class EngineClosedError(MXNetError):
     """Named failure for futures outstanding when an engine shuts down
     (or when its serving loop dies): raised AT WAIT by every affected
@@ -59,13 +75,14 @@ class EngineClosedError(MXNetError):
 
 
 class _Request:
-    __slots__ = ("inputs", "n", "future", "t_submit")
+    __slots__ = ("inputs", "n", "future", "t_submit", "trace")
 
-    def __init__(self, inputs, n, future, t_submit):
+    def __init__(self, inputs, n, future, t_submit, trace=None):
         self.inputs = inputs      # {name: np.ndarray with leading n}
         self.n = n                # samples in this request
         self.future = future
         self.t_submit = t_submit
+        self.trace = trace        # TraceContext | None (observer only)
 
 
 class _PredictorModel:
@@ -268,6 +285,12 @@ class InferenceEngine:
         if prewarm:
             self.warmup()
 
+        # ops surface: MXNET_METRICS_PORT (no-op when unset) + the
+        # /statusz engine section (one engine per serving process in
+        # the fleet; a later engine in the same process takes over)
+        profiler.maybe_start_metrics_server()
+        profiler.register_statusz("engine", self.stats)
+
         self._batcher = threading.Thread(
             target=self._batch_loop, daemon=True,
             name="mxnet_tpu-serving-batcher")
@@ -286,14 +309,16 @@ class InferenceEngine:
         return cls(_ExportedModel(path_or_bytes), **kwargs)
 
     # -- client surface -------------------------------------------------
-    def submit(self, inputs) -> Future:
+    def submit(self, inputs, trace=None) -> Future:
         """Enqueue one request; returns a Future resolving to the list
         of output arrays, each with leading dim = this request's sample
         count.
 
         ``inputs``: ``{input_name: array}`` (leading batch dim, or a
         bare per-sample shape for n=1), or a single array when the
-        model has exactly one input.
+        model has exactly one input.  ``trace``: optional
+        :class:`profiler.TraceContext` — the engine stamps its queue
+        and exec spans as children (the fleet wire propagates it).
         """
         if not self._accepting:
             raise MXNetError(self._reject or "InferenceEngine is closed")
@@ -332,7 +357,7 @@ class InferenceEngine:
                 f"request of {n} samples exceeds max_batch "
                 f"{self._max_batch}; split it client-side")
         fut: Future = Future()
-        req = _Request(batch, n, fut, time.perf_counter())
+        req = _Request(batch, n, fut, time.perf_counter(), trace=trace)
         # gauge only — exporting the same family as both a histogram
         # and a gauge would make prometheus_text() an invalid exposition
         profiler.set_gauge("serving.queue_depth", self._queue.qsize())
@@ -476,6 +501,9 @@ class InferenceEngine:
         out["requests_per_s"] = summ["rates"].get("requests", 0.0)
         out["images_per_s"] = summ["rates"].get("images", 0.0)
         out["buckets"] = list(self._buckets)
+        out["latency_breakdown"] = _phase_breakdown(
+            summ, {"queue_wait": "queue_wait_ms",
+                   "exec": "batch_ms", "total": "latency_ms"})
         return out
 
     # -- lifecycle ------------------------------------------------------
@@ -562,6 +590,8 @@ class InferenceEngine:
             # every queued request would otherwise wait forever and
             # close() would block on a completer that never gets its
             # sentinel — fail them all with a named error instead
+            profiler.dump_flight_record(
+                "engine_crash", extra={"error": repr(exc)})
             self._shutdown(EngineClosedError(
                 f"InferenceEngine batch loop died: {exc!r}"))
             raise
@@ -659,6 +689,17 @@ class InferenceEngine:
         from .io import stage_array
 
         t0 = time.perf_counter()
+        for req in batch:
+            # per-request queue/coalesce wait: the first slice of the
+            # latency-breakdown (and a child span of the request trace)
+            wait_ms = (t0 - req.t_submit) * 1e3
+            self._metrics.observe("queue_wait_ms", wait_ms)
+            profiler.observe("serving.queue_wait_ms", wait_ms)
+            if req.trace is not None:
+                profiler.add_trace_event(
+                    "serving.queue", req.t_submit, t0 - req.t_submit,
+                    req.trace.child(), cat="serving",
+                    args={"n": req.n, "reason": reason})
         try:
             bucket = self._bucket_for(total)
             compiled_now = bucket not in self._cache
@@ -682,7 +723,11 @@ class InferenceEngine:
                                 args={"bucket": bucket, "n": total,
                                       "reason": reason}):
                 outs = exe(padded)  # async dispatch; completion thread blocks
-        except Exception as exc:
+        except BaseException as exc:
+            # BaseException too: a KeyboardInterrupt/MemoryError here
+            # kills the batch loop, and by this point the batch is off
+            # the queue and out of _building — nothing else can fail
+            # these futures, so an Exception-only net would strand them
             for req in batch:
                 if not req.future.set_running_or_notify_cancel():
                     continue
@@ -764,6 +809,13 @@ class InferenceEngine:
                 # in the top bucket) for as long as the caller holds it
                 rows = [np.array(o[off:off + req.n]) for o in host]
                 off += req.n
+                if req.trace is not None:
+                    # the batch's device time, as THIS request's child
+                    # span — every rider shares the same bounds
+                    profiler.add_trace_event(
+                        "serving.exec", t0, now - t0,
+                        req.trace.child(), cat="serving",
+                        args={"bucket": bucket, "n": req.n})
                 if req.future.set_running_or_notify_cancel():
                     req.future.set_result(rows)
                 lat_ms = (now - req.t_submit) * 1e3
@@ -818,9 +870,10 @@ class _Stream:
 
     __slots__ = ("sid", "prompt", "max_new", "temp", "eos", "future",
                  "seed", "generated", "blocks", "length", "next_token",
-                 "resume", "t_submit", "t_admit")
+                 "resume", "t_submit", "t_admit", "trace", "t_enqueue")
 
-    def __init__(self, sid, prompt, max_new, temp, eos, future, seed):
+    def __init__(self, sid, prompt, max_new, temp, eos, future, seed,
+                 trace=None):
         self.sid = sid
         self.prompt = prompt          # np.int32 (P,)
         self.max_new = max_new
@@ -835,6 +888,8 @@ class _Stream:
         self.resume = False           # re-prefill after preemption
         self.t_submit = time.perf_counter()
         self.t_admit = 0.0
+        self.t_enqueue = self.t_submit  # (re)joined the pending queue
+        self.trace = trace            # TraceContext | None
 
     def prefill_seq(self) -> np.ndarray:
         """Token sequence whose K/V the cache must hold before the
@@ -1076,6 +1131,10 @@ class DecodeEngine:
         if prewarm:
             self.warmup()
 
+        # ops surface (MXNET_METRICS_PORT-gated) + /statusz section
+        profiler.maybe_start_metrics_server()
+        profiler.register_statusz("engine", self.stats)
+
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name="mxnet_tpu-serving-decode")
@@ -1085,7 +1144,7 @@ class DecodeEngine:
     # client surface
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, temperature=None,
-               eos_id=None, seed=None) -> Future:
+               eos_id=None, seed=None, trace=None) -> Future:
         """Enqueue one generation; the Future resolves to the np.int32
         array of generated token ids (eos, when hit, is included).
 
@@ -1094,7 +1153,12 @@ class DecodeEngine:
         stream seed, position), so two engines constructed with the
         same weights and engine ``seed`` produce BIT-IDENTICAL tokens
         for the same (prompt, seed) — the property the fleet router's
-        exactly-once retry of a dead replica's requests rests on."""
+        exactly-once retry of a dead replica's requests rests on.
+
+        ``trace``: optional :class:`profiler.TraceContext` — the
+        stream's queue wait, prefill, and every decode-step batch it
+        rides in become child spans of it (propagated over the fleet
+        wire; purely an observer)."""
         prompt = np.asarray(prompt)
         if prompt.ndim != 1 or prompt.size < 1:
             raise MXNetError(
@@ -1128,7 +1192,7 @@ class DecodeEngine:
                     self._reject or "DecodeEngine is closed")
             s = _Stream(self._next_sid, prompt, max_new, temp, eos, fut,
                         seed=(self._next_sid + 1 if seed is None
-                              else int(seed)))
+                              else int(seed)), trace=trace)
             self._next_sid += 1
             self._pending.append(s)
             self._owned.add(fut)
@@ -1253,6 +1317,11 @@ class DecodeEngine:
         out["cache_buckets"] = list(self._cache_buckets)
         out["prefill_buckets"] = list(self._prefill_buckets)
         out["kv_block"] = self._kv_block
+        out["latency_breakdown"] = _phase_breakdown(
+            summ, {"queue_wait": "queue_wait_ms",
+                   "prefill": "prefill_ms",
+                   "decode": "time_per_token_ms",
+                   "ttft": "ttft_ms"})
         return out
 
     # ------------------------------------------------------------------
@@ -1463,6 +1532,8 @@ class DecodeEngine:
                 profiler.set_gauge("serving.active_streams",
                                    len(self._active))
         except BaseException as exc:
+            profiler.dump_flight_record(
+                "engine_crash", extra={"error": repr(exc)})
             self._shut_door()  # before poisoning: submit() must not
             self._fail_outstanding(EngineClosedError(  # re-queue work
                 f"DecodeEngine serving loop died: {exc!r}"))
@@ -1528,6 +1599,7 @@ class DecodeEngine:
         seeds = np.asarray([s.seed], np.int32)
         steps = np.asarray([n - 1], np.int32)  # sampling position
         dev = self._device
+        t_pre0 = time.perf_counter()
         with profiler.scope(f"serving.prefill.t{tp}", "serving",
                             args={"tokens": n, "bucket": tp,
                                   "resume": s.resume}):
@@ -1540,7 +1612,28 @@ class DecodeEngine:
             first = int(np.asarray(toks)[0])
         s.blocks = pages
         s.length = n
-        s.t_admit = time.perf_counter()
+        t_done = time.perf_counter()
+        prefill_ms = (t_done - t_pre0) * 1e3
+        self._metrics.observe("prefill_ms", prefill_ms)
+        profiler.observe("serving.prefill_ms", prefill_ms)
+        if s.trace is not None:
+            # queue wait (enqueue → prefill start) and the prefill
+            # itself, as child spans of the request's trace — a resume
+            # prefill's queue span covers only the post-preemption
+            # wait, not the service time already rendered
+            profiler.add_trace_event(
+                "serving.queue", s.t_enqueue, t_pre0 - s.t_enqueue,
+                s.trace.child(), cat="serving",
+                args={"sid": s.sid, "resume": s.resume})
+            profiler.add_trace_event(
+                "serving.prefill", t_pre0, t_done - t_pre0,
+                s.trace.child(), cat="serving",
+                args={"sid": s.sid, "tokens": n, "bucket": tp,
+                      "resume": s.resume})
+        wait_ms = (t_pre0 - s.t_enqueue) * 1e3
+        self._metrics.observe("queue_wait_ms", wait_ms)
+        profiler.observe("serving.queue_wait_ms", wait_ms)
+        s.t_admit = t_done
         if s.resume:
             s.resume = False  # next_token survives preemption
         else:
@@ -1600,6 +1693,7 @@ class DecodeEngine:
         victim.blocks = []
         victim.length = 0
         victim.resume = True
+        victim.t_enqueue = time.perf_counter()  # re-queued from NOW
         with self._lock:
             self._active.remove(victim)
             self._pending.insert(0, victim)
@@ -1657,7 +1751,8 @@ class DecodeEngine:
                 stage_array(seeds, dev), stage_array(steps, dev),
                 self._pools)
             toks = np.asarray(toks)
-        step_ms = (time.perf_counter() - t0) * 1e3
+        t_done = time.perf_counter()
+        step_ms = (t_done - t0) * 1e3
         self._count("steps")
         self._count("tokens", n)
         self._metrics.observe("step_ms", step_ms)
@@ -1670,6 +1765,15 @@ class DecodeEngine:
             s.next_token = tok
             self._metrics.observe("time_per_token_ms", step_ms)
             profiler.observe("serving.time_per_token_ms", step_ms)
+            if s.trace is not None:
+                # every decode-step batch this stream rode in becomes
+                # one child span — a request's flame graph shows its
+                # whole token cadence, including steps it shared
+                profiler.add_trace_event(
+                    "serving.decode_step", t0, t_done - t0,
+                    s.trace.child(), cat="serving",
+                    args={"sid": s.sid, "position": s.length,
+                          "batch": bb, "active": n})
             if s.done():
                 retired.append(s)
         if retired:
@@ -1714,22 +1818,26 @@ class ReplicaHarness:
         self.kind = "decode" if isinstance(engine, DecodeEngine) \
             else "infer"
         self.weights_step = -1  # last swap's checkpoint step
+        # /statusz: the harness view supersedes the bare engine's —
+        # same stats plus kind/inflight/weights_step (what fleet_top
+        # renders per replica)
+        profiler.register_statusz("engine", self.stats)
 
     # -- uniform submit -------------------------------------------------
-    def submit_infer(self, inputs) -> Future:
+    def submit_infer(self, inputs, trace=None) -> Future:
         if self.kind != "infer":
             raise MXNetError("replica serves decode requests; "
                              "an inference request cannot ride it")
-        return self.engine.submit(inputs)
+        return self.engine.submit(inputs, trace=trace)
 
     def submit_decode(self, prompt, max_new_tokens=32, temperature=None,
-                      eos_id=None, seed=None) -> Future:
+                      eos_id=None, seed=None, trace=None) -> Future:
         if self.kind != "decode":
             raise MXNetError("replica serves inference requests; "
                              "a decode request cannot ride it")
         return self.engine.submit(prompt, max_new_tokens,
                                   temperature=temperature, eos_id=eos_id,
-                                  seed=seed)
+                                  seed=seed, trace=trace)
 
     # -- router-facing state --------------------------------------------
     def inflight(self) -> int:

@@ -34,7 +34,7 @@ __all__ = [
     "U32", "U64", "I64", "pack_key", "unpack_key", "pack_tensor",
     "unpack_tensor", "send_frame", "recv_frame", "recv_exact",
     "err_body", "raise_if_err", "sign", "verify", "pack_signed_json",
-    "unpack_signed_json", "is_transient",
+    "unpack_signed_json", "is_transient", "pack_trace", "unpack_trace",
 ]
 
 U32 = struct.Struct("!I")
@@ -170,6 +170,44 @@ def raise_if_err(resp: memoryview, who: str = "server") -> memoryview:
     if resp[0] != 0:
         raise MXNetError(f"{who}: {unpack_err(resp)}")
     return resp
+
+
+# ---------------------------------------------------------------------------
+# distributed-trace context (optional field on request/control frames)
+# ---------------------------------------------------------------------------
+
+
+def pack_trace(ctx) -> bytes:
+    """Optional trace-context field: ``u8 len | ascii traceparent``
+    (len 0 = untraced — one byte on the wire, so sampling a request
+    out costs nothing).  ``ctx`` may be a
+    :class:`profiler.TraceContext`, a ready traceparent string, or
+    None."""
+    if ctx is None:
+        return b"\x00"
+    header = ctx if isinstance(ctx, str) else ctx.to_header()
+    hb = header.encode("ascii")
+    if len(hb) > 0xFF:
+        raise MXNetError(f"traceparent too long ({len(hb)} bytes)")
+    return struct.pack("!B", len(hb)) + hb
+
+
+def unpack_trace(buf: memoryview, off: int):
+    """→ (TraceContext | None, new offset).  A malformed header is
+    dropped (None) rather than failing the request: tracing is an
+    observer, never a gate."""
+    n = buf[off]
+    off += 1
+    if not n:
+        return None, off
+    raw = bytes(buf[off:off + n]).decode("ascii", errors="replace")
+    off += n
+    from .profiler import TraceContext
+
+    try:
+        return TraceContext.from_header(raw), off
+    except ValueError:
+        return None, off
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,84 @@
+"""Fake-replica worker for the two-process trace-stitching test.
+
+Serves ONE in-process fake harness (no engine, no compile — a
+deterministic x*2 forward with a real span) on the fleet wire, with
+the flight recorder ring-filing into the shared fleet dir.  The
+parent test routes a traced request through a real Router →
+ReplicaClient → this process, then stitches both processes' flight
+rings into one tree.
+
+Usage (spawned by tests/test_tracing.py):
+    MXNET_WORKER_ID=1 MXNET_FLIGHT_RECORDER_DIR=<fleet_dir> \
+        python tests/fleet_trace_worker.py <fleet_dir>
+"""
+
+import os
+import sys
+import time
+from concurrent.futures import Future
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+class FakeHarness:
+    """The ReplicaServer duck type, minus the engine: submit_infer
+    answers inputs['data'] * 2 and stamps a replica-side span so the
+    stitched tree crosses the process boundary."""
+
+    def submit_infer(self, inputs, trace=None):
+        from mxnet_tpu import profiler
+
+        fut = Future()
+        with profiler.trace_span("replica.exec", trace, cat="serving",
+                                 args={"pid": os.getpid()}):
+            time.sleep(0.01)  # a visible span, wider than clock jitter
+            out = [np.asarray(inputs["data"], np.float32) * 2.0]
+        fut.set_result(out)
+        return fut
+
+    def submit_decode(self, *a, **kw):
+        raise RuntimeError("fake replica serves infer only")
+
+    def inflight(self):
+        return 0
+
+    def drain(self, timeout=30.0):
+        return 0
+
+    def resume(self):
+        pass
+
+    def stats(self):
+        return {"kind": "fake"}
+
+    def swap(self, ckpt_dir, drain_timeout=60.0):
+        raise RuntimeError("fake replica has no weights")
+
+    def close(self, timeout=30.0):
+        pass
+
+
+def main():
+    fleet_dir = sys.argv[1]
+    from mxnet_tpu import profiler
+    from mxnet_tpu.checkpoint import atomic_write_bytes
+    from mxnet_tpu.fleet import ReplicaServer, read_secret
+
+    profiler.init_flight_recorder(fleet_dir)
+    server = ReplicaServer(FakeHarness(), rid=0, fleet_dir=fleet_dir,
+                           secret=read_secret(fleet_dir))
+    atomic_write_bytes(os.path.join(fleet_dir, "ep_0"),
+                       f"127.0.0.1:{server.port}".encode())
+    parent = os.getppid()
+    while not server.wait_closed(timeout=0.5):
+        if os.getppid() != parent:
+            break  # orphaned: the test died
+    profiler.flight_recorder().sync()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -254,6 +254,13 @@ def test_elastic_barrier_straggler_is_not_a_death(monkeypatch, tmp_path):
     kv = mx.kv.create("dist_sync")
     kv._active = [0, 1]
 
+    # the peer's heartbeat must exist BEFORE the barrier's first
+    # staleness scan (never-wrote = dead is the correct verdict for a
+    # peer with no heartbeat) — on a loaded single-core box the thread
+    # may not get scheduled before the scan, which is a test race, not
+    # a straggler conviction
+    (hb / "hb_1").write_text("x")
+
     def late_peer():
         # keep the peer's heartbeat fresh, stamp the barrier late
         for _ in range(6):

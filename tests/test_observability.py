@@ -283,10 +283,21 @@ def test_prometheus_text():
     assert 'mxnet_serving_requests{rank="0"} 3' in text
     assert "# TYPE mxnet_executor_live_buffer_bytes gauge" in text
     assert 'mxnet_executor_live_buffer_bytes{rank="0"} 1024' in text
-    assert "# TYPE mxnet_serving_latency_ms summary" in text
-    assert 'quantile="0.9"' in text
+    # PR 12: registry histograms export as REAL Prometheus histograms
+    # (cumulative _bucket series over the fixed ladder + _sum/_count)
+    assert "# TYPE mxnet_serving_latency_ms histogram" in text
+    assert 'mxnet_serving_latency_ms_bucket{rank="0",le="1"} 1' in text
+    assert 'mxnet_serving_latency_ms_bucket{rank="0",le="2.5"} 2' in text
+    assert 'mxnet_serving_latency_ms_bucket{rank="0",le="+Inf"} 3' in text
     assert 'mxnet_serving_latency_ms_count{rank="0"} 3' in text
     assert 'mxnet_serving_latency_ms_sum{rank="0"} 6' in text
+    # the old percentile flattening survives one release as _pNN gauges
+    assert "# TYPE mxnet_serving_latency_ms_p99 gauge" in text
+    assert 'mxnet_serving_latency_ms_p50{rank="0"} 2' in text
+    # the pre-PR-12 summary form is GONE (a histogram family plus a
+    # same-name summary would be an invalid exposition)
+    assert "summary" not in text
+    assert 'quantile=' not in text
     mx.profiler.reset_metrics()
 
 

@@ -187,7 +187,8 @@ def main_sweep(args):
                      "requests": len(lats),
                      "req_s": round(len(lats) / wall, 2),
                      "shed_rate": round(stats["shed_rate"], 4),
-                     "lost": errs["lost"], **_pcts(lats)}
+                     "lost": errs["lost"], **_pcts(lats),
+                     "latency_breakdown": stats["latency_breakdown"]}
             sweep.append(point)
             log(f"point: {point}")
         finally:
@@ -203,16 +204,93 @@ def main_sweep(args):
         "p99_ms": best["p99_ms"], "shed_rate": best["shed_rate"],
         "vs_single_replica": (round(best["req_s"] / single["req_s"], 2)
                               if single and single["req_s"] else None),
+        "latency_breakdown": best["latency_breakdown"],
         "clients": clients, "model": "mlp", "sweep": sweep,
     }))
     return 0
 
 
+def _stitch_drill_trace(fleet_dir, ring_dir, killed_pid):
+    """Merge the fleet dir's flight rings (incl. the kill -9'd
+    replica's — its mmap pages survived the process) into one
+    Perfetto trace, and pull out a RETRIED request's stitched tree:
+    the acceptance artifact whose timeline visibly spans the dead
+    replica, the conviction window (the router.retry span), and the
+    surviving replica."""
+    import glob
+
+    from mxnet_tpu import profiler
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_merge as tm
+
+    profiler.flight_recorder().sync()
+    # rings live where the recorder was pointed — the fleet dir by
+    # default, or the operator's MXNET_FLIGHT_RECORDER_DIR (replicas
+    # inherit the same env, so both cases are one glob)
+    rings = sorted(glob.glob(os.path.join(ring_dir, "flight_*.ring")))
+    traces = []
+    for f in rings:
+        try:
+            traces.append(tm.load_trace(f))
+        except Exception as exc:  # noqa: BLE001
+            log(f"unreadable flight ring {f}: {exc}")
+    out = {"stitched_trace": None, "retried_trace": None,
+           "postmortem_from_killed": False}
+    killed = glob.glob(os.path.join(
+        ring_dir, f"flight_rank*_pid{killed_pid}.ring"))
+    if killed:
+        try:
+            doc = tm.load_trace(killed[0])
+            out["postmortem_from_killed"] = \
+                len(doc["traceEvents"]) > 0
+            out["killed_ring_events"] = len(doc["traceEvents"])
+        except Exception as exc:  # noqa: BLE001
+            log(f"killed replica ring unreadable: {exc}")
+    if not traces:
+        return out
+    merged = tm.merge_traces(traces)
+    path = os.path.join(fleet_dir, "drill_trace.json")
+    with open(path, "w") as f:
+        json.dump(merged, f)
+    out["stitched_trace"] = path
+    retry_tids = [e["args"]["trace_id"] for e in merged["traceEvents"]
+                  if e.get("name") == "router.retry"
+                  and (e.get("args") or {}).get("trace_id")]
+    if retry_tids:
+        tid = retry_tids[0]
+        roots = tm.trace_tree(merged["traceEvents"], tid)
+
+        def _walk(nodes):
+            for n in nodes:
+                yield n
+                yield from _walk(n["children"])
+
+        nodes = list(_walk(roots))
+        pids = {n["event"].get("pid") for n in nodes}
+        out["retried_trace"] = {
+            "trace_id": tid, "spans": len(nodes),
+            "processes": len(pids),
+            "has_retry_span": any(
+                n["event"]["name"] == "router.retry" for n in nodes),
+        }
+        log("retried request's stitched tree:\n"
+            + tm.format_tree(roots))
+    return out
+
+
 def main_drill(args):
     """kill -9 one of two replicas under load; then a rolling swap."""
     from mxnet_tpu import checkpoint as ckpt_mod
+    from mxnet_tpu import profiler
 
     fleet_dir = args.fleet_dir or tempfile.mkdtemp(prefix="fleet-drill-")
+    # flight recorder: router + replicas all ring-file into the fleet
+    # dir (replicas inherit the env), so the kill -9'd process leaves
+    # its post-mortem where the stitcher looks
+    os.environ.setdefault("MXNET_FLIGHT_RECORDER_DIR", fleet_dir)
+    ring_dir = os.environ["MXNET_FLIGHT_RECORDER_DIR"]
+    profiler.init_flight_recorder(ring_dir)
     router, procs = _launch(args.replicas, fleet_dir,
                             replica_depth=4)
     ref = _reference()
@@ -298,6 +376,11 @@ def main_drill(args):
         swap_shed = router.stats()["shed"] - shed_before \
             + len(swap_errs)
 
+        # observability artifacts: the stitched per-request trace and
+        # the killed replica's flight-recorder post-mortem
+        stitched = _stitch_drill_trace(fleet_dir, ring_dir,
+                                       procs[0].pid)
+
         verdict = {
             "metric": "fleet_drill",
             "replicas": args.replicas,
@@ -310,6 +393,8 @@ def main_drill(args):
             "duplicates": stats["duplicates"],
             **_pcts(lats),
             "p99_trace_ms": p99_trace,
+            "latency_breakdown": stats["latency_breakdown"],
+            **stitched,
             "swap_ok": bool(swap_ok),
             "swap_shed": int(swap_shed),
             "swap_report": swap,
@@ -318,7 +403,8 @@ def main_drill(args):
         print(json.dumps(verdict))
         return 0 if (verdict["lost"] == 0 and verdict["mismatched"] == 0
                      and verdict["replica_deaths"] == 1 and swap_ok
-                     and swap_shed == 0) else 1
+                     and swap_shed == 0
+                     and verdict["postmortem_from_killed"]) else 1
     finally:
         router.close(stop_replicas=True)
         for p in procs:

@@ -441,6 +441,10 @@ def main_decode():
             "kv_block": st["kv_block"],
             "decode_buckets": st["decode_buckets"],
             "compiles": st["compiles"],
+            # per-phase percentiles from the per-request spans: a p99
+            # regression names queue_wait/prefill/decode, not just one
+            # opaque number (lifetime over the whole sweep)
+            "latency_breakdown": st["latency_breakdown"],
             "sweep": sweep,
         }))
     finally:

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""fleet_top — one table for a whole serving fleet.
+
+Polls the per-process ``/statusz`` ops endpoints (see
+``mx.profiler.start_metrics_server`` / ``MXNET_METRICS_PORT``) and
+renders one row per process: replica id, pid, engine kind, inflight,
+active streams, cache utilization, tokens/s, p99, weight step,
+membership epoch, goodput/MFU — so a fleet under load is inspectable
+without attaching a debugger to any process.
+
+Endpoints come from either:
+
+* a fleet dir (``--fleet-dir``): replicas publish their ephemeral
+  ops ports as ``mz_<rid>`` files (fleet._replica_main);
+* explicit ``host:port`` arguments (a trainer's
+  ``MXNET_METRICS_PORT``, a router process, ...).
+
+Usage:
+    python tools/fleet_top.py --fleet-dir /tmp/fleet-xyz
+    python tools/fleet_top.py 127.0.0.1:9100 127.0.0.1:9101 --watch 2
+
+``--watch N`` redraws every N seconds; default is one shot.  ``--json``
+dumps the raw merged statusz documents instead of the table (for
+scripts).  Stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def discover_endpoints(fleet_dir: Optional[str],
+                       explicit: List[str]) -> List[Tuple[str, str]]:
+    """→ [(label, host:port)] from mz_* files and CLI args."""
+    eps: List[Tuple[str, str]] = []
+    if fleet_dir:
+        for path in sorted(glob.glob(os.path.join(fleet_dir, "mz_*"))):
+            rid = os.path.basename(path)[3:]
+            try:
+                with open(path) as f:
+                    eps.append((f"r{rid}", f.read().strip()))
+            except OSError:
+                continue
+    for i, hp in enumerate(explicit):
+        eps.append((f"ep{i}", hp))
+    return eps
+
+
+def poll(endpoint: str, timeout: float = 2.0) -> Optional[Dict]:
+    try:
+        with urllib.request.urlopen(
+                f"http://{endpoint}/statusz", timeout=timeout) as r:
+            return json.loads(r.read())
+    except Exception:  # noqa: BLE001 — a dead process is a row, not a crash
+        return None
+
+
+def _fmt(v, spec="", dash="-"):
+    if v is None:
+        return dash
+    try:
+        return format(v, spec)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def _pick(doc: Dict, *path, default=None):
+    cur: Any = doc
+    for k in path:
+        if not isinstance(cur, dict) or k not in cur:
+            return default
+        cur = cur[k]
+    return cur
+
+
+def rows(docs: List[Tuple[str, str, Optional[Dict]]]) -> List[List[str]]:
+    out = []
+    for label, ep, doc in docs:
+        if doc is None:
+            out.append([label, ep, "DOWN"] + ["-"] * 9)
+            continue
+        eng = doc.get("engine") or {}
+        g = doc.get("gauges") or {}
+        tr = doc.get("training") or {}
+        p99 = (_pick(eng, "latency_breakdown", "total", "p99_ms")
+               or _pick(eng, "latency_breakdown", "decode", "p99_ms")
+               or eng.get("p99_ms"))
+        out.append([
+            label, ep,
+            _fmt(doc.get("pid")),
+            _fmt(eng.get("kind") or ("train" if tr.get("steps") else "")),
+            _fmt(eng.get("inflight")),
+            _fmt(eng.get("active_streams")),
+            _fmt(eng.get("cache_util"), ".0%"),
+            _fmt(eng.get("tokens_per_s") or eng.get("requests_per_s"),
+                 ".1f"),
+            _fmt(p99, ".1f"),
+            _fmt(eng.get("weights_step") if eng.get("weights_step")
+                 is not None else g.get("serving.weights_step")),
+            _fmt(g.get("elastic.epoch"), ".0f"),
+            (f"{_fmt(tr.get('goodput'), '.2f')}/"
+             f"{_fmt(tr.get('mfu'), '.3f')}"
+             if tr.get("steps") else "-"),
+        ])
+    return out
+
+
+_HEADER = ["ID", "ENDPOINT", "PID", "KIND", "INFL", "ACTIVE", "CACHE",
+           "RATE", "P99MS", "WSTEP", "EPOCH", "GOODPUT/MFU"]
+
+
+def render(table: List[List[str]]) -> str:
+    widths = [max(len(str(r[i])) for r in [_HEADER] + table)
+              for i in range(len(_HEADER))]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(_HEADER, widths))]
+    for r in table:
+        lines.append("  ".join(str(c).ljust(w)
+                               for c, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("endpoints", nargs="*",
+                    help="host:port of /statusz endpoints")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="fleet dir with mz_<rid> endpoint files")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SEC",
+                    help="redraw every SEC seconds (0 = one shot)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump raw statusz documents as JSON")
+    args = ap.parse_args(argv)
+    if not args.endpoints and not args.fleet_dir:
+        ap.error("give host:port endpoints and/or --fleet-dir")
+    while True:
+        eps = discover_endpoints(args.fleet_dir, args.endpoints)
+        docs = [(label, ep, poll(ep)) for label, ep in eps]
+        if args.json:
+            print(json.dumps({label: doc for label, _, doc in docs},
+                             default=str))
+        else:
+            if args.watch:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear screen
+            up = sum(1 for _, _, d in docs if d is not None)
+            print(f"fleet_top  {time.strftime('%H:%M:%S')}  "
+                  f"{up}/{len(docs)} up")
+            print(render(rows(docs)))
+        if not args.watch:
+            return 0 if docs and any(d for _, _, d in docs) else 1
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Merge per-rank Chrome traces into one clock-aligned timeline.
+"""Merge per-rank Chrome traces into one clock-aligned timeline, and
+stitch per-REQUEST span trees across processes.
 
 Every distributed worker dumps its own trace
 (``mx.profiler.dump_rank_trace(dir)`` → ``trace_rank<N>.json``); each
@@ -12,8 +13,29 @@ writes one Chrome-trace JSON viewable in Perfetto
 (https://ui.perfetto.dev) or chrome://tracing — the Dapper-style
 "where did this step go, on every worker" view.
 
-    python tools/trace_merge.py /tmp/traces/trace_rank*.json -o merged.json
-    python tools/trace_merge.py /tmp/traces -o merged.json   # a directory
+Three input kinds share the ONE clock_sync convention (so no per-tool
+skew heuristics are needed):
+
+* per-rank Chrome traces (``trace_rank*.json``) and flight-recorder
+  post-mortem dumps (``flightdump_*.json`` — already Chrome-shaped);
+* flight-recorder mmap RING files (``flight_*.ring``) — the record a
+  kill -9'd process leaves behind; recovered here with the torn line
+  at the wrap seam skipped;
+* metrics-reporter JSONL files (``*.jsonl``) — each summary line
+  becomes Chrome counter events on the shared timeline.
+
+Fleet request spans carry ``trace_id``/``span_id``/``parent_span_id``
+in their args (mx.profiler.TraceContext); after merging, this tool
+stitches them back into per-request trees:
+
+    python tools/trace_merge.py /tmp/traces -o merged.json
+    python tools/trace_merge.py /tmp/traces --list-traces
+    python tools/trace_merge.py /tmp/traces --tree <trace_id>
+
+``--tree`` prints the request's flame graph as text ("why was this
+request's TTFT 900 ms" in one look); the merged JSON additionally
+gets Perfetto flow arrows linking parent→child spans across process
+tracks.
 
 Alignment quality is whatever the hosts' wall clocks share (NTP —
 typically well under a millisecond inside one cluster); events within
@@ -26,11 +48,93 @@ import argparse
 import glob
 import json
 import os
+import struct
 import sys
 from typing import Any, Dict, List
 
+# flight-recorder ring-file header — keep in sync with
+# mxnet_tpu/profiler.py FlightRecorder (standalone copy: this tool
+# must not import the package)
+_FLIGHT_MAGIC = b"MXFLTREC"
+_FLIGHT_HDR = struct.Struct("<8sQQddII")
+
+
+def load_flight_ring(path: str) -> Dict[str, Any]:
+    """Recover a flight-recorder mmap ring file (survives kill -9) →
+    a Chrome-trace dict with the shared clock_sync metadata."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    magic, cap, written, wall0, t0, rank, pid = \
+        _FLIGHT_HDR.unpack_from(raw, 0)
+    if magic != _FLIGHT_MAGIC:
+        raise ValueError(f"{path}: not a flight-recorder ring file")
+    data = raw[_FLIGHT_HDR.size:_FLIGHT_HDR.size + cap]
+    buf = data[:written] if written <= cap else \
+        data[written % cap:] + data[:written % cap]
+    events = []
+    for line in buf.split(b"\n"):
+        if not line.strip():
+            continue
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue  # torn at the wrap seam
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"flight_recorder": True, "rank": rank,
+                         "pid": pid,
+                         "clock_sync": {"wall_time_s": wall0,
+                                        "perf_counter_s": t0}}}
+
+
+def load_reporter_jsonl(path: str) -> Dict[str, Any]:
+    """A Reporter JSONL metrics file → Chrome counter events.  Each
+    line carries the same clock_sync anchor as the traces (PR 12), so
+    the metric timeline lands skew-free next to the spans."""
+    events: List[Dict[str, Any]] = []
+    meta: Dict[str, Any] = {}
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                line = json.loads(ln)
+            except ValueError:
+                continue
+            sync = line.get("clock_sync")
+            if sync and not meta:
+                meta = {"rank": line.get("rank", 0),
+                        "clock_sync": sync}
+            if not sync:
+                continue
+            # the merger later adds (wall0 - base); relative to this
+            # file's own anchor the line sits at (t - wall0) — exactly
+            # the convention span ts use ((start - t0) on the perf
+            # clock == (start_wall - wall0) on the wall clock)
+            ts_us = (line["t"] - sync["wall_time_s"]) * 1e6
+            pid = line.get("rank", 0)
+            for fam in ("gauges", "counters"):
+                for k, v in (line.get(fam) or {}).items():
+                    events.append({"name": k, "ph": "C", "ts": ts_us,
+                                   "pid": pid, "tid": 0,
+                                   "args": {"value": v}})
+    if not meta:
+        raise ValueError(
+            f"{path}: no clock_sync-stamped reporter lines (pre-PR-12 "
+            "reporter files can't be aligned skew-free)")
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"reporter": True, **meta}}
+
 
 def load_trace(path: str) -> Dict[str, Any]:
+    """Load any supported input by sniffing: mmap ring files by magic,
+    reporter JSONL by extension/shape, Chrome traces otherwise."""
+    with open(path, "rb") as f:
+        head = f.read(8)
+    if head == _FLIGHT_MAGIC:
+        return load_flight_ring(path)
+    if path.endswith(".jsonl"):
+        return load_reporter_jsonl(path)
     with open(path) as f:
         trace = json.load(f)
     if "traceEvents" not in trace:
@@ -90,6 +194,7 @@ def merge_traces(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
             out_events.append({"name": "process_name", "ph": "M",
                                "pid": new_pid, "tid": 0,
                                "args": {"name": label}})
+    add_flow_events(out_events)
     return {
         "traceEvents": out_events,
         "displayTimeUnit": "ms",
@@ -97,14 +202,125 @@ def merge_traces(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+# ---------------------------------------------------------------------------
+# per-request stitching (trace_id / span_id / parent_span_id in args)
+# ---------------------------------------------------------------------------
+
+
+def _span_events(events):
+    for ev in events:
+        args = ev.get("args") or {}
+        if ev.get("ph") in ("X", "i") and "trace_id" in args \
+                and "span_id" in args:
+            yield ev, args
+
+
+def list_traces(events) -> Dict[str, int]:
+    """trace_id -> span count over merged events."""
+    out: Dict[str, int] = {}
+    for _, args in _span_events(events):
+        out[args["trace_id"]] = out.get(args["trace_id"], 0) + 1
+    return out
+
+
+def trace_tree(events, trace_id: str) -> List[Dict[str, Any]]:
+    """Stitch one request's spans (across every merged process) into
+    parent→child trees.  Returns the list of root nodes, each
+    ``{"event", "span_id", "children": [...]}`` with children sorted
+    by start ts — the structure the tier-1 two-process stitching test
+    asserts monotonic clock-aligned bounds on."""
+    nodes: Dict[str, Dict[str, Any]] = {}
+    order = []
+    for ev, args in _span_events(events):
+        if args["trace_id"] != trace_id:
+            continue
+        node = {"event": ev, "span_id": args["span_id"],
+                "parent": args.get("parent_span_id"),
+                "children": []}
+        # duplicate span ids (shouldn't happen — ids are random 64-bit)
+        # keep first
+        if args["span_id"] not in nodes:
+            nodes[args["span_id"]] = node
+            order.append(node)
+    roots = []
+    for node in order:
+        parent = nodes.get(node["parent"]) if node["parent"] else None
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    for node in order:
+        node["children"].sort(key=lambda n: n["event"].get("ts", 0.0))
+    roots.sort(key=lambda n: n["event"].get("ts", 0.0))
+    return roots
+
+
+def format_tree(roots, indent: int = 0) -> str:
+    """ASCII flame view of a stitched request tree."""
+    lines = []
+    for node in roots:
+        ev = node["event"]
+        ts = ev.get("ts", 0.0) / 1e3
+        dur = ev.get("dur", 0.0) / 1e3
+        args = ev.get("args") or {}
+        where = f"pid {ev.get('pid')}"
+        extra = {k: v for k, v in args.items()
+                 if k not in ("trace_id", "span_id", "parent_span_id")}
+        lines.append(f"{'  ' * indent}{ev['name']}  "
+                     f"[{ts:.3f} ms +{dur:.3f} ms]  ({where})"
+                     + (f"  {extra}" if extra else ""))
+        lines.append(format_tree(node["children"], indent + 1))
+    return "\n".join(ln for ln in lines if ln)
+
+
+def add_flow_events(events) -> int:
+    """Perfetto flow arrows (`ph` s/f pairs) from every child span
+    back to its parent — the cross-process edges render as arrows
+    between process tracks, turning the per-rank rows into one
+    request flame graph.  Returns the number of edges added."""
+    by_span = {}
+    for ev, args in _span_events(events):
+        by_span.setdefault(args["span_id"], (ev, args))
+    flows = []
+    flow_id = 0
+    for ev, args in list(_span_events(list(events))):
+        parent = args.get("parent_span_id")
+        if not parent or parent not in by_span:
+            continue
+        pev, _ = by_span[parent]
+        if pev.get("pid") == ev.get("pid") \
+                and pev.get("tid") == ev.get("tid"):
+            continue  # same track: nesting already shows the edge
+        flow_id += 1
+        common = {"name": ev["name"], "cat": "traceflow",
+                  "id": flow_id}
+        flows.append({**common, "ph": "s", "pid": pev["pid"],
+                      "tid": pev.get("tid", 0),
+                      "ts": pev.get("ts", 0.0)})
+        flows.append({**common, "ph": "f", "bp": "e",
+                      "pid": ev["pid"], "tid": ev.get("tid", 0),
+                      "ts": ev.get("ts", 0.0)})
+    events.extend(flows)
+    return flow_id
+
+
+_DIR_PATTERNS = ("trace_rank*.json", "flightdump_*.json",
+                 "flight_*.ring", "*.jsonl")
+
+
 def collect_inputs(paths: List[str]) -> List[str]:
-    """Expand directories to their trace_rank*.json files."""
+    """Expand directories to their trace / flight-recorder / reporter
+    files."""
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            found = sorted(glob.glob(os.path.join(p, "trace_rank*.json")))
+            found: List[str] = []
+            for pat in _DIR_PATTERNS:
+                found.extend(sorted(glob.glob(os.path.join(p, pat))))
             if not found:
-                raise SystemExit(f"{p}: no trace_rank*.json files")
+                raise SystemExit(
+                    f"{p}: no trace_rank*.json / flightdump_*.json / "
+                    "flight_*.ring / *.jsonl files")
             files.extend(found)
         else:
             files.append(p)
@@ -113,17 +329,49 @@ def collect_inputs(paths: List[str]) -> List[str]:
     return files
 
 
+def load_traces(files: List[str]) -> List[Dict[str, Any]]:
+    """Load every input, warning and skipping the unreadable (a stray
+    .jsonl without clock anchors, a torn dump) instead of aborting
+    the whole merge; at least one must load."""
+    traces = []
+    for f in files:
+        try:
+            traces.append(load_trace(f))
+        except (ValueError, OSError) as exc:
+            print(f"skipping {f}: {exc}", file=sys.stderr)
+    if not traces:
+        raise SystemExit("no readable input traces")
+    return traces
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("inputs", nargs="+",
-                        help="per-rank trace files, or a directory of "
-                             "trace_rank*.json")
+                        help="per-rank traces, flightdump_*.json, "
+                             "flight_*.ring, reporter *.jsonl, or a "
+                             "directory of them")
     parser.add_argument("-o", "--output", default="merged_trace.json")
+    parser.add_argument("--list-traces", action="store_true",
+                        help="print trace_id -> span count and exit")
+    parser.add_argument("--tree", metavar="TRACE_ID", default=None,
+                        help="print one request's stitched span tree "
+                             "and exit")
     args = parser.parse_args(argv)
     files = collect_inputs(args.inputs)
-    merged = merge_traces([load_trace(f) for f in files])
+    merged = merge_traces(load_traces(files))
+    if args.list_traces:
+        for tid, n in sorted(list_traces(merged["traceEvents"]).items(),
+                             key=lambda kv: -kv[1]):
+            print(f"{tid}  {n} span(s)")
+        return
+    if args.tree:
+        roots = trace_tree(merged["traceEvents"], args.tree)
+        if not roots:
+            raise SystemExit(f"no spans for trace {args.tree}")
+        print(format_tree(roots))
+        return
     with open(args.output, "w") as f:
         json.dump(merged, f)
     n_ev = sum(1 for e in merged["traceEvents"] if e.get("ph") != "M")
