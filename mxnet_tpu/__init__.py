@@ -61,6 +61,7 @@ from . import wire
 from . import fleet
 from .fleet import Router, FleetClient, ShedError
 from . import kv_cache
+from . import prefix_cache
 from . import parallel
 from . import pp
 from . import sequence

@@ -391,6 +391,37 @@ register_env(
     "bucket).  Unset: kv_block-sized doubling ladder up to max_len.  "
     "Malformed ladders raise at engine construction.")
 register_env(
+    "MXNET_SERVING_PREFIX_CACHE", 1, int,
+    "1 (default): serving.DecodeEngine shares KV-cache pages between "
+    "streams with common block-aligned prompt prefixes — a radix "
+    "index maps cached prefixes to ref-counted page chains, admission "
+    "attaches a new stream to existing pages (prefill runs only on "
+    "the uncached suffix; a fully-cached prompt skips prefill "
+    "entirely), writes to shared pages copy-on-write, and refcount-0 "
+    "cached pages evict LRU under pressure (MXNET_SERVING_EVICT).  "
+    "0: the exclusive-owner cache (decode output bit-identical to "
+    "the pre-sharing engine).  Values other than 0/1 raise at engine "
+    "construction.")
+register_env(
+    "MXNET_SERVING_KV_DTYPE", "fp32", str,
+    "KV-cache page storage dtype for serving.DecodeEngine: 'fp32' "
+    "(default, bit-exact), 'bf16' (plain narrow cast, 2x less cache "
+    "HBM), 'int8' or 'fp8' (ml_dtypes float8_e4m3fn; ~4x less, "
+    "quantize-on-write with per-page-slot-per-head float32 scales, "
+    "dequantized inside the paged-decode kernel with fp32 softmax "
+    "accumulation — the bf16-gradient-wire precedent: lossy storage, "
+    "exact math).  Unknown names raise at engine construction; 'fp8' "
+    "raises when the toolchain lacks float8_e4m3fn.")
+register_env(
+    "MXNET_SERVING_EVICT", "lru", str,
+    "Eviction policy for refcount-0 prefix-cached KV pages: 'lru' "
+    "(default) keeps them parked and reclaims leaf-first in "
+    "least-recently-used order (deterministic logical clock) when "
+    "the pool runs dry; 'off' frees pages the moment their last "
+    "stream detaches (no retention — prefix hits then only come from "
+    "still-running streams).  Unknown values raise at engine "
+    "construction.")
+register_env(
     "MXNET_FLEET_REPLICAS", 2, int,
     "Replica-process count for fleet.launch_local_fleet / "
     "tools/bench_fleet.py when none is given explicitly.  Each replica "

@@ -20,9 +20,21 @@ Design (PagedAttention, Kwon et al. SOSP '23):
   decode program mask-free — reads of scratch are always masked by
   the per-stream length.
 
+Prefix sharing (RadixAttention, Zheng et al. '23) adds **reference
+counting**: a page holding a fully-written block of a common prompt
+prefix may back several streams at once.  ``share``/``release`` move
+a page's refcount; a page whose count reaches zero while the prefix
+index still maps its content is **parked** (``release(...,
+park=True)``) — it keeps its bytes and can be revived on the next
+prefix hit, or reclaimed (``reclaim``) when the pool runs dry.  A
+page referenced by N streams occupies ONE slot and is counted once
+everywhere (``used_blocks`` / ``cache_util``); parked pages count as
+free capacity because they are reclaimable on demand.
+
 The allocator is intentionally dumb and exact: a LIFO free list and
-integer arithmetic, no heuristics.  Admission control and preemption
-policy live in :class:`mxnet_tpu.serving.DecodeEngine`; the
+integer arithmetic, no heuristics.  Admission control, preemption and
+the eviction *policy* live in :class:`mxnet_tpu.serving.DecodeEngine`
+and :class:`mxnet_tpu.prefix_cache.PrefixCache`; the
 ``serving.cache_util`` gauge is maintained here so every alloc/free
 updates it.
 """
@@ -31,23 +43,81 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from . import profiler
 from .base import MXNetError
 
-__all__ = ["BlockAllocator", "blocks_for_tokens", "bucket_ladder"]
+__all__ = ["BlockAllocator", "blocks_for_tokens", "bucket_ladder",
+           "kv_storage_dtype", "kv_quantized", "KV_DTYPES", "KV_QMAX"]
 
 SCRATCH_PAGE = 0
 
+# MXNET_SERVING_KV_DTYPE vocabulary.  fp32 is the bit-exact reference;
+# bf16 is a plain narrow-float cast (no scales); int8/fp8 store
+# quantized values plus per-slot-per-head float32 scales, dequantized
+# inside the decode attention (fp32 softmax accumulation throughout —
+# the PR-3 bf16-gradient-wire precedent: lossy storage, exact math).
+KV_DTYPES = ("fp32", "bf16", "int8", "fp8")
+KV_QMAX = {"int8": 127.0, "fp8": 448.0}  # e4m3 finite max
+
+
+def kv_quantized(name: str) -> bool:
+    """Does this KV storage dtype carry per-slot scale pools?"""
+    return name in KV_QMAX
+
+
+def kv_storage_dtype(name: str) -> np.dtype:
+    """Numpy dtype backing the device K/V pools for a
+    ``MXNET_SERVING_KV_DTYPE`` name; unknown names raise loudly at
+    engine construction."""
+    if name == "fp32":
+        return np.dtype(np.float32)
+    if name == "bf16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    if name == "int8":
+        return np.dtype(np.int8)
+    if name == "fp8":
+        try:
+            import ml_dtypes
+            return np.dtype(ml_dtypes.float8_e4m3fn)
+        except (ImportError, AttributeError):
+            raise MXNetError(
+                "MXNET_SERVING_KV_DTYPE=fp8 needs ml_dtypes with "
+                "float8_e4m3fn; use int8 or bf16 on this toolchain")
+    raise MXNetError(
+        f"unknown KV cache dtype {name!r} (MXNET_SERVING_KV_DTYPE "
+        f"wants one of {KV_DTYPES})")
+
 
 def blocks_for_tokens(tokens: int, block_tokens: int) -> int:
-    """Pages needed to hold ``tokens`` cache entries."""
-    return -(-int(tokens) // int(block_tokens))
+    """Pages needed to hold ``tokens`` cache entries.
+
+    Edge contract: ``blocks_for_tokens(0, b) == 0`` — an empty suffix
+    (a fully prefix-cached prompt) needs no new pages, and
+    ``alloc(0)`` returns an empty page list rather than failing.
+    Negative token counts are a caller bug and raise."""
+    tokens = int(tokens)
+    if tokens < 0:
+        raise MXNetError(f"blocks_for_tokens({tokens}): negative")
+    return -(-tokens // int(block_tokens))
 
 
 def bucket_ladder(max_value: int, base: int = 1) -> List[int]:
     """Doubling ladder ``base, 2*base, ...`` capped at (and always
     including) ``max_value`` — the executable-cache bucketing shape
-    used for batch sizes, cache blocks and prefill lengths."""
+    used for batch sizes, cache blocks and prefill lengths.
+
+    Edge contract: ``max_value < 1`` raises loudly — a ladder must
+    contain at least one positive bucket (downstream validation
+    rejects ``[0]`` anyway, but the diagnosis belongs here, at the
+    sizing bug, not at engine construction)."""
+    if int(max_value) < 1:
+        raise MXNetError(
+            f"bucket_ladder({max_value}): a bucket ladder needs a "
+            f"positive top — zero-token work is the 0-page path "
+            f"(blocks_for_tokens(0) == 0), not a bucket")
     out = []
     v = max(1, int(base))
     while v < max_value:
@@ -58,12 +128,16 @@ def bucket_ladder(max_value: int, base: int = 1) -> List[int]:
 
 
 class BlockAllocator:
-    """Free-list allocator over ``num_blocks`` fixed-size token pages.
+    """Ref-counted free-list allocator over ``num_blocks`` fixed-size
+    token pages.
 
     Page 0 is reserved as the shared scratch page and never handed
     out.  ``alloc`` is all-or-nothing: a request that cannot be fully
     satisfied takes nothing (the caller decides whether to preempt,
-    queue, or shrink)."""
+    queue, or shrink).  Pages come back at refcount 1; ``share``
+    attaches another holder, ``release`` detaches one.  A released
+    page either returns to the free list or — ``park=True`` — keeps
+    its bytes as reclaimable cache."""
 
     def __init__(self, num_blocks: int, block_tokens: int):
         if num_blocks < 2:
@@ -78,6 +152,8 @@ class BlockAllocator:
         # pages are reused first
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._owner: Dict[int, object] = {}  # page -> stream tag
+        self._refs: Dict[int, int] = {}      # page -> holder count
+        self._parked: set = set()            # refcount-0 cached pages
         self._update_gauges()
 
     # ------------------------------------------------------------------
@@ -88,11 +164,32 @@ class BlockAllocator:
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Pages available to a new allocation: truly free ones plus
+        parked (refcount-0 cached) ones, which are reclaimable on
+        demand.  A page shared by N streams is ABSENT from this count
+        exactly once — sharing never inflates apparent capacity."""
+        return len(self._free) + len(self._parked)
 
     @property
     def used_blocks(self) -> int:
-        return self.capacity - len(self._free)
+        """Pages some stream actively references (refcount >= 1).
+        N streams on one page count it ONCE."""
+        return self.capacity - self.free_blocks
+
+    @property
+    def free_list_blocks(self) -> int:
+        """Pages immediately allocatable without an eviction."""
+        return len(self._free)
+
+    @property
+    def parked_blocks(self) -> int:
+        """Refcount-0 cached pages awaiting revival or reclaim."""
+        return len(self._parked)
+
+    @property
+    def shared_blocks(self) -> int:
+        """Pages currently referenced by MORE than one stream."""
+        return sum(1 for r in self._refs.values() if r > 1)
 
     def utilization(self) -> float:
         return self.used_blocks / self.capacity if self.capacity else 0.0
@@ -101,10 +198,19 @@ class BlockAllocator:
         return blocks_for_tokens(tokens, self.block_tokens) \
             <= self.free_blocks
 
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+    def is_parked(self, page: int) -> bool:
+        return page in self._parked
+
     # ------------------------------------------------------------------
     def alloc(self, n: int, owner=None) -> Optional[List[int]]:
-        """Take ``n`` pages, or None (and take nothing) if they are
-        not all available."""
+        """Take ``n`` pages at refcount 1, or None (and take nothing)
+        if they are not all available from the free list.  Parked
+        pages are NOT taken implicitly — the caller (the prefix
+        cache's eviction policy) must ``reclaim`` them first, so an
+        eviction is always an explicit, countable decision."""
         if n < 0:
             raise MXNetError(f"alloc({n})")
         if n > len(self._free):
@@ -112,18 +218,83 @@ class BlockAllocator:
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._owner[p] = owner
+            self._refs[p] = 1
         self._update_gauges()
         return pages
 
+    def share(self, page: int) -> int:
+        """Attach one more holder to a live page; returns the new
+        refcount."""
+        if page not in self._refs:
+            raise MXNetError(f"share of non-live page {page}")
+        self._refs[page] += 1
+        self._update_gauges()
+        return self._refs[page]
+
+    def revive(self, page: int, owner=None) -> None:
+        """Re-activate a parked page at refcount 1 (a prefix hit on a
+        cached page no stream currently holds)."""
+        if page not in self._parked:
+            raise MXNetError(f"revive of non-parked page {page} "
+                             f"(parked: {sorted(self._parked)})")
+        self._parked.discard(page)
+        self._owner[page] = owner
+        self._refs[page] = 1
+        self._update_gauges()
+
+    def release(self, page: int, park: bool = False) -> int:
+        """Detach one holder; returns the remaining refcount.  At zero
+        the page returns to the free list, or — ``park=True`` — keeps
+        its bytes as reclaimable cache (the prefix index still maps
+        its content)."""
+        if page not in self._refs:
+            raise MXNetError(f"release of non-live page {page}")
+        self._refs[page] -= 1
+        left = self._refs[page]
+        if left == 0:
+            del self._refs[page]
+            del self._owner[page]
+            if park:
+                self._parked.add(page)
+            else:
+                self._free.append(page)
+        self._update_gauges()
+        return left
+
+    def reclaim(self, page: int) -> None:
+        """Move a parked page to the free list (the prefix index has
+        dropped its entry — an eviction)."""
+        if page not in self._parked:
+            raise MXNetError(f"reclaim of non-parked page {page}")
+        self._parked.discard(page)
+        self._free.append(page)
+        self._update_gauges()
+
     def free(self, pages: List[int]) -> None:
+        """Terminal free of EXCLUSIVELY-held pages.  A page another
+        stream still references raises loudly — returning it to the
+        free list would hand the same page to a new stream while the
+        sharer still reads it (silent cross-stream corruption).
+        Shared pages go through :meth:`release` instead."""
         for p in pages:
             if p == SCRATCH_PAGE:
                 raise MXNetError("attempt to free the scratch page")
+            if p in self._parked:
+                # cached, no holders: freeing it is a plain reclaim
+                self._parked.discard(p)
+                self._free.append(p)
+                continue
             if p not in self._owner:
                 raise MXNetError(
                     f"double free / foreign page {p} (owned pages: "
                     f"{sorted(self._owner)})")
+            if self._refs.get(p, 0) > 1:
+                raise MXNetError(
+                    f"free of page {p} with {self._refs[p]} live "
+                    f"references — another stream still reads it; "
+                    f"release() the caller's reference instead")
             del self._owner[p]
+            self._refs.pop(p, None)
             self._free.append(p)
         self._update_gauges()
 
@@ -131,4 +302,7 @@ class BlockAllocator:
     def _update_gauges(self):
         profiler.set_gauge("serving.cache_blocks_used", self.used_blocks)
         profiler.set_gauge("serving.cache_blocks_free", self.free_blocks)
+        profiler.set_gauge("serving.cache_blocks_cached",
+                           self.parked_blocks)
+        profiler.set_gauge("serving.shared_blocks", self.shared_blocks)
         profiler.set_gauge("serving.cache_util", self.utilization())

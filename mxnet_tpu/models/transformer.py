@@ -204,9 +204,17 @@ def _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block, attend_for,
     return sym.Group([logits] + caches)
 
 
+def _kv_quant(kv_dtype):
+    from ..kv_cache import KV_DTYPES, kv_quantized
+
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(f"kv_dtype {kv_dtype!r} not in {KV_DTYPES}")
+    return kv_quantized(kv_dtype)
+
+
 def transformer_lm_prefill(vocab_size, num_layers=4, num_heads=4,
                            d_model=128, d_ff=None, kv_block=16,
-                           paged=True):
+                           paged=True, kv_dtype="fp32"):
     """Prefill symbol: the full causal forward over a (padded) prompt
     that ALSO writes each layer's K/V state into the cache.
 
@@ -216,8 +224,15 @@ def transformer_lm_prefill(vocab_size, num_layers=4, num_heads=4,
     ``[logits (B, T, vocab)] + [updated caches ...]``.  Attention runs
     at ``block_size=kv_block`` so the logits are bit-identical to
     ``transformer_lm(..., block_size=kv_block)`` rows (lax path).
+
+    ``kv_dtype``: K/V pool storage — 'fp32'/'bf16' write through the
+    plain ops (a bf16 pool is just a narrow cast); 'int8'/'fp8' route
+    through the quantize-on-write ops and add per-layer
+    ``layer{i}_kscale``/``layer{i}_vscale`` (P, KVB, H) float32 scale
+    pools, making each layer contribute FOUR cache outputs.
     """
     lengths = sym.Variable("lengths")
+    quant = _kv_quant(kv_dtype)
 
     def attend_for(i):
         def attend(qkv):
@@ -227,6 +242,15 @@ def transformer_lm_prefill(vocab_size, num_layers=4, num_heads=4,
             out, k, v = att[0], att[1], att[2]
             if not paged:
                 return out, [k, v]
+            if quant:
+                pools = sym.PagedCacheWriteQ(
+                    k, v, sym.Variable(f"layer{i}_kpool"),
+                    sym.Variable(f"layer{i}_vpool"),
+                    sym.Variable(f"layer{i}_kscale"),
+                    sym.Variable(f"layer{i}_vscale"),
+                    sym.Variable("block_table"), lengths,
+                    name=f"layer{i}_cache_write")
+                return out, [pools[0], pools[1], pools[2], pools[3]]
             pools = sym.PagedCacheWrite(
                 k, v, sym.Variable(f"layer{i}_kpool"),
                 sym.Variable(f"layer{i}_vpool"),
@@ -239,9 +263,52 @@ def transformer_lm_prefill(vocab_size, num_layers=4, num_heads=4,
                      attend_for, vocab_size)
 
 
+def transformer_lm_prefix_prefill(vocab_size, num_layers=4, num_heads=4,
+                                  d_model=128, d_ff=None, kv_block=16,
+                                  kv_dtype="fp32"):
+    """Suffix-prefill symbol for a prefix-cache hit: the forward runs
+    ONLY over the uncached suffix of the prompt, attending the shared
+    prefix through the paged cache.
+
+    Inputs: ``data``/``positions`` (B, Ts) — the suffix tokens at
+    absolute positions ``start[b] + i``; ``start`` (B,) int32 cached
+    (block-aligned) token counts; ``lengths`` (B,) int32 TOTAL tokens
+    (start + real suffix); ``block_table`` (B, MB) covering prefix AND
+    suffix pages; per-layer pools (+ scale pools when quantized).
+    Outputs: ``[suffix logits (B, Ts, vocab)] + [updated caches]``.
+    Bit-identical (lax path, fp32 pools) to the matching rows of the
+    full causal forward — see ``ops.attention.prefix_suffix_attention``.
+    """
+    lengths = sym.Variable("lengths")
+    start = sym.Variable("start")
+    quant = _kv_quant(kv_dtype)
+
+    def attend_for(i):
+        def attend(qkv):
+            if quant:
+                att = sym.QKVPagedPrefillAttendQ(
+                    qkv, sym.Variable(f"layer{i}_kpool"),
+                    sym.Variable(f"layer{i}_vpool"),
+                    sym.Variable(f"layer{i}_kscale"),
+                    sym.Variable(f"layer{i}_vscale"),
+                    sym.Variable("block_table"), start, lengths,
+                    num_heads=num_heads, name=f"layer{i}_attn")
+                return att[0], [att[1], att[2], att[3], att[4]]
+            att = sym.QKVPagedPrefillAttend(
+                qkv, sym.Variable(f"layer{i}_kpool"),
+                sym.Variable(f"layer{i}_vpool"),
+                sym.Variable("block_table"), start, lengths,
+                num_heads=num_heads, name=f"layer{i}_attn")
+            return att[0], [att[1], att[2]]
+        return attend
+
+    return _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block,
+                     attend_for, vocab_size)
+
+
 def transformer_lm_decode(vocab_size, num_layers=4, num_heads=4,
                           d_model=128, d_ff=None, kv_block=16,
-                          paged=True):
+                          paged=True, kv_dtype="fp32"):
     """Decode-mode symbol: ONE token per stream per step against the
     KV cache.
 
@@ -255,10 +322,20 @@ def transformer_lm_decode(vocab_size, num_layers=4, num_heads=4,
     full-sequence forward — the page size is the attention block size.
     """
     lengths = sym.Variable("lengths")
+    quant = _kv_quant(kv_dtype)
 
     def attend_for(i):
         def attend(qkv):
-            if paged:
+            if paged and quant:
+                att = sym.QKVPagedAttentionDecodeQ(
+                    qkv, sym.Variable(f"layer{i}_kpool"),
+                    sym.Variable(f"layer{i}_vpool"),
+                    sym.Variable(f"layer{i}_kscale"),
+                    sym.Variable(f"layer{i}_vscale"),
+                    sym.Variable("block_table"), lengths,
+                    num_heads=num_heads, name=f"layer{i}_attn")
+                return att[0], [att[1], att[2], att[3], att[4]]
+            elif paged:
                 att = sym.QKVPagedAttentionDecode(
                     qkv, sym.Variable(f"layer{i}_kpool"),
                     sym.Variable(f"layer{i}_vpool"),

@@ -46,7 +46,8 @@ def blockwise_attention_partial(q, k, v, causal=False, block_size=512,
 
 
 def _blockwise_attention_partial_lax(q, k, v, causal, block_size,
-                                     kv_offset, lengths=None):
+                                     kv_offset, lengths=None,
+                                     init_state=None):
     """The pure lax.scan formulation — reference semantics and the
     remat backward for the Pallas forward.
 
@@ -59,7 +60,13 @@ def _blockwise_attention_partial_lax(q, k, v, causal, block_size,
     row of the full-sequence causal forward: shared blocks see the same
     values and the same effective mask, and a fully-masked trailing
     block is an exact no-op of the online-softmax merge (alpha == 1,
-    p == 0 contributions)."""
+    p == 0 contributions).
+
+    ``init_state``: an (o, m, l) carry to CONTINUE from instead of the
+    empty state — chaining two calls scans their blocks as one
+    sequence, so splitting a key range across calls (cached prefix
+    pages, then raw suffix K/V — the prefix-cache suffix prefill) is
+    bit-identical to a single scan over the concatenation."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
@@ -97,7 +104,8 @@ def _blockwise_attention_partial_lax(q, k, v, causal, block_size,
         o_new = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_j)
         return (o_new, m_new, l_new), None
 
-    o0, m0, l0 = attention_state_init(q)
+    o0, m0, l0 = attention_state_init(q) if init_state is None \
+        else init_state
     (o, m, l), _ = lax.scan(
         body, (o0, m0, l0),
         (kb.swapaxes(0, 1), vb.swapaxes(0, 1), jnp.arange(nblocks)))
@@ -309,6 +317,33 @@ def _unpack_qkv(qkv, H):
     return q, k, v, D
 
 
+def quantize_kv(x, qdtype):
+    """Quantize K or V state (..., H, D) to ``qdtype`` (int8 or an fp8
+    type) with one float32 scale per (..., H) — per token slot, per
+    head.  The scale maps each head's max-|value| to the dtype's
+    representable max, so pages written once keep their bytes forever
+    (a shared full page is immutable; no page-wide re-scaling drift).
+    Returns (q, scale)."""
+    from ..kv_cache import KV_QMAX
+
+    qdtype = jnp.dtype(qdtype)
+    qmax = KV_QMAX["int8"] if qdtype == jnp.int8 else KV_QMAX["fp8"]
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    y = x32 / scale[..., None]
+    if qdtype == jnp.int8:
+        q = jnp.clip(jnp.round(y), -qmax, qmax).astype(jnp.int8)
+    else:
+        q = y.astype(qdtype)
+    return q, scale
+
+
+def dequantize_kv(q, scale):
+    """Inverse of :func:`quantize_kv`: float32 values."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
 def cache_update(cache_k, cache_v, k_t, v_t, lengths):
     """Scatter the current token's K/V into a contiguous (B, C, H, D)
     cache at position ``lengths - 1``.  Streams with lengths == 0
@@ -339,20 +374,74 @@ def paged_cache_update(k_pool, v_pool, k_t, v_t, block_table, lengths):
             v_pool.at[page, slot].set(v_t[:, 0].astype(v_pool.dtype)))
 
 
-def paged_prefill_write(k, v, k_pool, v_pool, block_table, lengths):
-    """Scatter a whole prompt's K/V (B, T, H, D) into the paged pools.
-    Positions >= lengths[b] (prompt padding) are routed to the scratch
-    page 0 instead of being masked out of the scatter."""
-    KVB = k_pool.shape[1]
-    B, T = k.shape[0], k.shape[1]
-    pos = jnp.arange(T)
-    live = pos[None, :] < lengths[:, None]                     # (B, T)
+def _paged_write_coords(block_table, lengths, T, KVB, start=None):
+    """(page, slot, live) scatter coordinates for a (B, T, ...) run of
+    tokens whose first row sits at absolute position ``start[b]``
+    (default 0 — the classic whole-prompt prefill).  Rows at or past
+    ``lengths[b]`` (padding) route to the scratch page 0."""
+    pos = jnp.broadcast_to(jnp.arange(T)[None, :],
+                           (block_table.shape[0], T))
+    if start is not None:
+        pos = pos + start[:, None]
+    live = pos < lengths[:, None]                              # (B, T)
     page = jnp.where(live,
-                     jnp.take_along_axis(
-                         block_table, pos[None, :] // KVB, axis=1), 0)
-    slot = jnp.where(live, pos[None, :] % KVB, 0)
+                     jnp.take_along_axis(block_table,
+                                         pos // KVB, axis=1), 0)
+    slot = jnp.where(live, pos % KVB, 0)
+    return page, slot, live
+
+
+def paged_prefill_write(k, v, k_pool, v_pool, block_table, lengths,
+                        start=None):
+    """Scatter a prompt's (or — with ``start`` — a prompt suffix's)
+    K/V (B, T, H, D) into the paged pools.  Positions >= lengths[b]
+    (padding) are routed to the scratch page 0 instead of being masked
+    out of the scatter."""
+    KVB = k_pool.shape[1]
+    T = k.shape[1]
+    page, slot, _ = _paged_write_coords(block_table, lengths, T, KVB,
+                                        start)
     return (k_pool.at[page, slot].set(k.astype(k_pool.dtype)),
             v_pool.at[page, slot].set(v.astype(v_pool.dtype)))
+
+
+def paged_prefill_write_q(k, v, k_pool, v_pool, k_scale, v_scale,
+                          block_table, lengths, start=None):
+    """Quantize-on-write prefill scatter: values land in the int8/fp8
+    pools, their per-slot-per-head float32 scales in the
+    (P, KVB, H) scale pools."""
+    KVB = k_pool.shape[1]
+    T = k.shape[1]
+    page, slot, _ = _paged_write_coords(block_table, lengths, T, KVB,
+                                        start)
+    kq, ks = quantize_kv(k, k_pool.dtype)
+    vq, vs = quantize_kv(v, v_pool.dtype)
+    return (k_pool.at[page, slot].set(kq),
+            v_pool.at[page, slot].set(vq),
+            k_scale.at[page, slot].set(ks),
+            v_scale.at[page, slot].set(vs))
+
+
+def paged_cache_update_q(k_pool, v_pool, k_scale, v_scale, k_t, v_t,
+                         block_table, lengths):
+    """Quantize-on-write single-token scatter (the decode step): the
+    new token's K/V quantizes against its own per-head scale and lands
+    in the narrow pools; the scales land in the (P, KVB, H) scale
+    pools.  Previously-written slots are untouched — no page-wide
+    re-scaling, so shared full pages keep their bytes."""
+    KVB = k_pool.shape[1]
+    pos = jnp.maximum(lengths - 1, 0)
+    B = block_table.shape[0]
+    rows = jnp.arange(B)
+    page = jnp.where(lengths > 0,
+                     block_table[rows, pos // KVB], 0)
+    slot = jnp.where(lengths > 0, pos % KVB, 0)
+    kq, ks = quantize_kv(k_t[:, 0], k_pool.dtype)   # (B, H, D), (B, H)
+    vq, vs = quantize_kv(v_t[:, 0], v_pool.dtype)
+    return (k_pool.at[page, slot].set(kq),
+            v_pool.at[page, slot].set(vq),
+            k_scale.at[page, slot].set(ks),
+            v_scale.at[page, slot].set(vs))
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_table, lengths):
@@ -377,6 +466,50 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, lengths):
     kg = k_pool[block_table].reshape(B, MB * KVB, H, D)
     vg = v_pool[block_table].reshape(B, MB * KVB, H, D)
     return decode_attention(q, kg, vg, lengths, KVB)
+
+
+def paged_decode_attention_q(q, k_pool, v_pool, k_scale, v_scale,
+                             block_table, lengths):
+    """Quantized-cache decode attention: the Pallas kernel dequantizes
+    each page in VMEM after its DMA; the lax fallback dequantizes the
+    gathered cache to float32 and runs the reference blockwise body
+    (fp32 softmax accumulation on both paths)."""
+    from . import pallas_kernels as pk
+
+    KVB = k_pool.shape[1]
+    if pk.enabled():
+        out = pk.paged_attention_decode_quant(
+            q[:, 0], k_pool, v_pool, k_scale, v_scale, block_table,
+            lengths)
+        return out[:, None]
+    B, MB = block_table.shape
+    H, D = k_pool.shape[2], k_pool.shape[3]
+    kg = dequantize_kv(k_pool[block_table].reshape(B, MB * KVB, H, D),
+                       k_scale[block_table].reshape(B, MB * KVB, H))
+    vg = dequantize_kv(v_pool[block_table].reshape(B, MB * KVB, H, D),
+                       v_scale[block_table].reshape(B, MB * KVB, H))
+    return decode_attention(q, kg, vg, lengths, KVB)
+
+
+def prefix_suffix_attention(q, k_suf, v_suf, kg, vg, start, block):
+    """Attention for a suffix prefill over a prefix-shared cache.
+
+    q/k_suf/v_suf (B, Ts, H, D) are the UNCACHED suffix (absolute
+    positions ``start[b] + i``); kg/vg (B, C, H, D) is the gathered
+    (and, if quantized, dequantized) paged cache whose first
+    ``start[b]`` slots hold the shared prefix.  Two chained scans over
+    the SAME online-softmax body — prefix blocks (key-visibility mask
+    ``k_pos < start``), then causal suffix blocks continuing the carry
+    — reproduce the full forward's block merge sequence exactly:
+    ``start`` is block-aligned, so every block either matches a full
+    forward block bit-for-bit or is a fully-masked exact no-op.  The
+    suffix attends its OWN K/V raw (pre-quantization), like the full
+    forward would."""
+    o, m, l = _blockwise_attention_partial_lax(
+        q, kg, vg, False, block, 0, lengths=start)
+    o, m, l = _blockwise_attention_partial_lax(
+        q, k_suf, v_suf, True, block, 0, init_state=(o, m, l))
+    return normalize_attention_state(o, m, l, q.dtype)
 
 
 def _qkv_prefill_infer(attrs, in_shapes):
@@ -525,6 +658,172 @@ def _paged_cache_write(op_ctx, attrs, inputs, aux):
         k, v, k_pool, v_pool, block_table.astype(jnp.int32),
         lengths.astype(jnp.int32))
     return [new_kp, new_vp]
+
+
+# ---------------------------------------------------------------------------
+# Prefix-shared + quantized cache ops.  The *Q variants carry the
+# (P, KVB, H) float32 scale pools alongside the int8/fp8 value pools
+# (quantize-on-write, dequantize-on-read, fp32 softmax accumulation);
+# the PrefillAttend pair is the suffix-only prefill of a prefix-cache
+# hit: the uncached suffix's K/V is written at offset ``start`` and
+# its queries attend cached-prefix pages + raw suffix causally.
+# ---------------------------------------------------------------------------
+
+
+def _paged_write_q_infer(attrs, in_shapes):
+    k, v, kp, vp, ks, vs, bt, ln = in_shapes
+    if kp is None:
+        return in_shapes, None, None
+    return in_shapes, [tuple(kp), tuple(vp if vp is not None else kp),
+                       tuple(ks) if ks is not None else None,
+                       tuple(vs if vs is not None else ks)
+                       if (vs is not None or ks is not None) else None], []
+
+
+@register("PagedCacheWriteQ",
+          arg_names=("key", "value", "k_pool", "v_pool", "k_scale",
+                     "v_scale", "block_table", "lengths"),
+          out_names=("new_k_pool", "new_v_pool", "new_k_scale",
+                     "new_v_scale"),
+          infer_shape=_paged_write_q_infer,
+          doc="PagedCacheWrite for QUANTIZED pools: the (B, T, H, D) "
+              "key/value state quantizes on write into int8/fp8 pools "
+              "with per-slot-per-head float32 scales in the "
+              "(P, KVB, H) scale pools.  Positions >= lengths[b] land "
+              "on the scratch page 0.")
+def _paged_cache_write_q(op_ctx, attrs, inputs, aux):
+    k, v, k_pool, v_pool, k_scale, v_scale, block_table, lengths = inputs
+    return list(paged_prefill_write_q(
+        k, v, k_pool, v_pool, k_scale, v_scale,
+        block_table.astype(jnp.int32), lengths.astype(jnp.int32)))
+
+
+def _qkv_paged_q_infer(attrs, in_shapes):
+    qkv, kp, vp, ks, vs, bt, ln = in_shapes
+    if qkv is None or kp is None:
+        return in_shapes, None, None
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    _check_qkv_packing(qkv[2], H, qkv)
+    _check_decode_step_shape("QKVPagedAttentionDecodeQ", qkv)
+    return in_shapes, [(qkv[0], 1, qkv[2] // 3), tuple(kp),
+                       tuple(vp if vp is not None else kp),
+                       tuple(ks) if ks is not None else None,
+                       tuple(vs) if vs is not None else None], []
+
+
+@register("QKVPagedAttentionDecodeQ",
+          arg_names=("qkv", "k_pool", "v_pool", "k_scale", "v_scale",
+                     "block_table", "lengths"),
+          out_names=("output", "new_k_pool", "new_v_pool",
+                     "new_k_scale", "new_v_scale"),
+          infer_shape=_qkv_paged_q_infer,
+          doc="QKVPagedAttentionDecode over QUANTIZED pools: the "
+              "current token's K/V quantizes on write (per-slot-per-"
+              "head scales); attention dequantizes inside the Pallas "
+              "page-gather kernel (lax fallback dequantizes the "
+              "gathered cache) with fp32 softmax accumulation; "
+              "attrs: num_heads")
+def _qkv_paged_attention_decode_q(op_ctx, attrs, inputs, aux):
+    qkv, k_pool, v_pool, k_scale, v_scale, block_table, lengths = inputs
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    _check_decode_step_shape("QKVPagedAttentionDecodeQ", qkv.shape)
+    q, k_t, v_t, D = _unpack_qkv(qkv, H)
+    lengths = lengths.astype(jnp.int32)
+    block_table = block_table.astype(jnp.int32)
+    new_kp, new_vp, new_ks, new_vs = paged_cache_update_q(
+        k_pool, v_pool, k_scale, v_scale, k_t, v_t, block_table,
+        lengths)
+    out = paged_decode_attention_q(q, new_kp, new_vp, new_ks, new_vs,
+                                   block_table, lengths)
+    B = qkv.shape[0]
+    return [jnp.reshape(out, (B, 1, H * D)), new_kp, new_vp, new_ks,
+            new_vs]
+
+
+def _qkv_prefix_infer(attrs, in_shapes):
+    qkv, kp, vp, bt, st, ln = in_shapes
+    if qkv is None or kp is None:
+        return in_shapes, None, None
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    _check_qkv_packing(qkv[2], H, qkv)
+    return in_shapes, [(qkv[0], qkv[1], qkv[2] // 3), tuple(kp),
+                       tuple(vp if vp is not None else kp)], []
+
+
+@register("QKVPagedPrefillAttend",
+          arg_names=("qkv", "k_pool", "v_pool", "block_table", "start",
+                     "lengths"),
+          out_names=("output", "new_k_pool", "new_v_pool"),
+          infer_shape=_qkv_prefix_infer,
+          doc="Suffix prefill over a prefix-shared paged cache: qkv "
+              "(B, Ts, 3*H*D) holds the UNCACHED suffix (absolute "
+              "positions start[b]+i, start block-aligned); its K/V is "
+              "written through the block table at that offset and its "
+              "queries attend the cached prefix pages plus the raw "
+              "suffix causally — bit-identical (lax path) to the full "
+              "causal forward's suffix rows.  start (B,) int32 cached "
+              "tokens, lengths (B,) int32 TOTAL tokens; attrs: "
+              "num_heads")
+def _qkv_paged_prefill_attend(op_ctx, attrs, inputs, aux):
+    qkv, k_pool, v_pool, block_table, start, lengths = inputs
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    q, k, v, D = _unpack_qkv(qkv, H)
+    lengths = lengths.astype(jnp.int32)
+    start = start.astype(jnp.int32)
+    block_table = block_table.astype(jnp.int32)
+    new_kp, new_vp = paged_prefill_write(
+        k, v, k_pool, v_pool, block_table, lengths, start=start)
+    KVB = k_pool.shape[1]
+    B, MB = block_table.shape
+    kg = new_kp[block_table].reshape(B, MB * KVB, H, D)
+    vg = new_vp[block_table].reshape(B, MB * KVB, H, D)
+    out = prefix_suffix_attention(q, k, v, kg, vg, start, KVB)
+    return [jnp.reshape(out, (B, qkv.shape[1], H * D)), new_kp, new_vp]
+
+
+def _qkv_prefix_q_infer(attrs, in_shapes):
+    qkv, kp, vp, ks, vs, bt, st, ln = in_shapes
+    if qkv is None or kp is None:
+        return in_shapes, None, None
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    _check_qkv_packing(qkv[2], H, qkv)
+    return in_shapes, [(qkv[0], qkv[1], qkv[2] // 3), tuple(kp),
+                       tuple(vp if vp is not None else kp),
+                       tuple(ks) if ks is not None else None,
+                       tuple(vs) if vs is not None else None], []
+
+
+@register("QKVPagedPrefillAttendQ",
+          arg_names=("qkv", "k_pool", "v_pool", "k_scale", "v_scale",
+                     "block_table", "start", "lengths"),
+          out_names=("output", "new_k_pool", "new_v_pool",
+                     "new_k_scale", "new_v_scale"),
+          infer_shape=_qkv_prefix_q_infer,
+          doc="QKVPagedPrefillAttend over QUANTIZED pools: the suffix "
+              "quantizes on write; the cached prefix dequantizes on "
+              "gather; the suffix attends its own K/V raw (pre-"
+              "quantization), fp32 softmax accumulation; attrs: "
+              "num_heads")
+def _qkv_paged_prefill_attend_q(op_ctx, attrs, inputs, aux):
+    (qkv, k_pool, v_pool, k_scale, v_scale, block_table, start,
+     lengths) = inputs
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    q, k, v, D = _unpack_qkv(qkv, H)
+    lengths = lengths.astype(jnp.int32)
+    start = start.astype(jnp.int32)
+    block_table = block_table.astype(jnp.int32)
+    new_kp, new_vp, new_ks, new_vs = paged_prefill_write_q(
+        k, v, k_pool, v_pool, k_scale, v_scale, block_table, lengths,
+        start=start)
+    KVB = k_pool.shape[1]
+    B, MB = block_table.shape
+    kg = dequantize_kv(new_kp[block_table].reshape(B, MB * KVB, H, D),
+                       new_ks[block_table].reshape(B, MB * KVB, H))
+    vg = dequantize_kv(new_vp[block_table].reshape(B, MB * KVB, H, D),
+                       new_vs[block_table].reshape(B, MB * KVB, H))
+    out = prefix_suffix_attention(q, k, v, kg, vg, start, KVB)
+    return [jnp.reshape(out, (B, qkv.shape[1], H * D)), new_kp, new_vp,
+            new_ks, new_vs]
 
 
 @register("DotProductAttention", arg_names=("query", "key", "value"),

@@ -1300,6 +1300,36 @@ def _mhap_bwd(qkv, o, lse, do, H, D, causal, block_size):
 # ---------------------------------------------------------------------------
 
 
+def _paged_fold_page(q, k, v, b, j, len_ref, acc_scr, m_scr, l_scr, *,
+                     scale, kvb):
+    """Fold one (KVB, H, D) page into the per-head online-softmax
+    state held in VMEM scratch — shared by the raw and the dequantized
+    kernels (softmax statistics accumulate in fp32 either way)."""
+    # s[h, t] = q[h, :] . k[t, h, :]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32) * scale
+    k_pos = j * kvb + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    valid = k_pos < len_ref[b]
+    s = jnp.where(valid, s, -jnp.inf)
+    m_prev = m_scr[:, 0]                      # (H,)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    m_safe = jnp.where(m_new == -jnp.inf, 0.0, m_new)
+    p = jnp.where(valid, jnp.exp(s - m_safe[:, None]), 0.0)
+    alpha = jnp.where(m_prev == -jnp.inf, 0.0,
+                      jnp.exp(m_prev - m_safe))
+    l_scr[...] = jnp.broadcast_to(
+        (l_scr[:, 0] * alpha + jnp.sum(p, axis=1))[:, None],
+        l_scr.shape)
+    # pv[h, d] = sum_t p[h, t] * v[t, h, d]
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)
+    acc_scr[...] = acc_scr[...] * alpha[:, None] + pv
+    m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+
+
 def _paged_decode_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                          acc_scr, m_scr, l_scr, *, scale, kvb, nb):
     b = pl.program_id(0)
@@ -1316,32 +1346,38 @@ def _paged_decode_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     # page, so the prefetch itself is always a valid page id)
     @pl.when(j * kvb < len_ref[b])
     def _compute():
-        q = q_ref[0]          # (H, D)
-        k = k_ref[0]          # (KVB, H, D)
-        v = v_ref[0]
-        # s[h, t] = q[h, :] . k[t, h, :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32) * scale
-        k_pos = j * kvb + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        valid = k_pos < len_ref[b]
-        s = jnp.where(valid, s, -jnp.inf)
-        m_prev = m_scr[:, 0]                      # (H,)
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        m_safe = jnp.where(m_new == -jnp.inf, 0.0, m_new)
-        p = jnp.where(valid, jnp.exp(s - m_safe[:, None]), 0.0)
-        alpha = jnp.where(m_prev == -jnp.inf, 0.0,
-                          jnp.exp(m_prev - m_safe))
-        l_scr[...] = jnp.broadcast_to(
-            (l_scr[:, 0] * alpha + jnp.sum(p, axis=1))[:, None],
-            l_scr.shape)
-        # pv[h, d] = sum_t p[h, t] * v[t, h, d]
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)
-        acc_scr[...] = acc_scr[...] * alpha[:, None] + pv
-        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        _paged_fold_page(q_ref[0], k_ref[0], v_ref[0], b, j, len_ref,
+                         acc_scr, m_scr, l_scr, scale=scale, kvb=kvb)
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        l = l_scr[:, 0]
+        o_ref[0] = (acc_scr[...]
+                    / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _paged_decode_quant_kernel(table_ref, len_ref, q_ref, k_ref, v_ref,
+                               ks_ref, vs_ref, o_ref, acc_scr, m_scr,
+                               l_scr, *, scale, kvb, nb):
+    """The quantized-cache variant: pages arrive in VMEM as int8/fp8
+    plus their (KVB, H) per-slot-per-head float32 scales and are
+    dequantized IN KERNEL, right after the DMA — the narrow dtype is
+    what crosses HBM, the fp32 values never materialize off-chip."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    @pl.when(j * kvb < len_ref[b])
+    def _compute():
+        k = k_ref[0].astype(jnp.float32) * ks_ref[0][:, :, None]
+        v = v_ref[0].astype(jnp.float32) * vs_ref[0][:, :, None]
+        _paged_fold_page(q_ref[0], k, v, b, j, len_ref,
+                         acc_scr, m_scr, l_scr, scale=scale, kvb=kvb)
 
     @pl.when(j == nb - 1)
     def _finish():
@@ -1390,3 +1426,48 @@ def paged_attention_decode(q, k_pool, v_pool, block_table, lengths):
         interpret=_interpret(),
     )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
       q, k_pool, v_pool)
+
+
+def paged_attention_decode_quant(q, k_pool, v_pool, k_scale, v_scale,
+                                 block_table, lengths):
+    """Quantized-cache paged decode: like :func:`paged_attention_decode`
+    but k_pool/v_pool hold int8 (or fp8) values and
+    k_scale/v_scale (P, KVB, H) float32 hold the per-slot-per-head
+    dequantization scales, applied in kernel after each page's DMA.
+    Softmax statistics and the P·V accumulation stay float32."""
+    B, H, D = q.shape
+    P, KVB = k_pool.shape[0], k_pool.shape[1]
+    MB = block_table.shape[1]
+    scale = 1.0 / float(D) ** 0.5
+    kern = functools.partial(_paged_decode_quant_kernel, scale=scale,
+                             kvb=KVB, nb=MB)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, MB),
+        in_specs=[
+            _vmem_spec((1, H, D), lambda b, j, tr, lr: (b, 0, 0)),
+            _vmem_spec((1, KVB, H, D),
+                       lambda b, j, tr, lr: (tr[b, j], 0, 0, 0)),
+            _vmem_spec((1, KVB, H, D),
+                       lambda b, j, tr, lr: (tr[b, j], 0, 0, 0)),
+            _vmem_spec((1, KVB, H),
+                       lambda b, j, tr, lr: (tr[b, j], 0, 0)),
+            _vmem_spec((1, KVB, H),
+                       lambda b, j, tr, lr: (tr[b, j], 0, 0)),
+        ],
+        out_specs=_vmem_spec((1, H, D), lambda b, j, tr, lr: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((H, D), jnp.float32),
+                        pltpu.VMEM((H, 128), jnp.float32),
+                        pltpu.VMEM((H, 128), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        compiler_params=(pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024)
+            if pltpu is not None and not _interpret() else None),
+        interpret=_interpret(),
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pool, v_pool, k_scale, v_scale)

@@ -1,0 +1,350 @@
+"""Prefix-shared KV cache: a radix index over block-aligned prompt
+prefixes, composed with the ref-counted page allocator.
+
+At scale most serving traffic shares long common prefixes — system
+prompts, few-shot templates, chat history — yet an exclusive-owner
+cache makes every stream pay full pages for bytes that are already on
+the device.  Two published designs compose to fix that, and both map
+directly onto this repo's paged cache:
+
+* **PagedAttention** (Kwon et al. SOSP '23): K/V lives in fixed-size
+  pages addressed through per-stream block tables, so *sharing a
+  prefix is a block-table splice* — N streams point rows of their
+  tables at the same page ids;
+* **RadixAttention** (SGLang, Zheng et al. '23): a radix tree over
+  token-block keys maps every cached block-aligned prefix to its page
+  chain, so admission finds the longest cached prefix in O(prompt
+  blocks) and prefill runs only on the uncached suffix.
+
+Sharing rules (the correctness core):
+
+* only **full** pages enter the index — a full page of a causal
+  model's K/V depends exclusively on the tokens at and before it, so
+  identical token prefixes mean bit-identical page bytes, and a full
+  page is never written again (immutable ⇒ shareable);
+* the **partially-filled tail** page is private by construction — the
+  index stores block-aligned prefixes only — EXCEPT on a fully-cached
+  block-aligned prompt, where the stream's first decode step must
+  re-write the last prompt token's slot: a write landing on a page
+  with other holders (or one the index still maps) triggers
+  **copy-on-write** — the engine allocates a private page, copies the
+  bytes on device, and splices its block table;
+* a page released by every holder while still indexed is **parked**:
+  it keeps its bytes and revives on the next hit, and is reclaimed in
+  strict LRU order (leaf-first, deterministic insertion/touch stamps)
+  when the pool runs dry (``MXNET_SERVING_EVICT=lru``; ``off``
+  disables retention — release frees immediately and drops the index
+  entry).
+
+This module is pure host-side bookkeeping (dict/tree arithmetic, no
+jax): :class:`mxnet_tpu.serving.DecodeEngine` drives it at admission
+(attach + suffix-only prefill), at each decode step (the COW probe),
+at preemption/retire (release), and inside allocation (evict-on-
+pressure).  Counters: ``serving.prefix_hits`` /
+``serving.prefix_hit_tokens`` / ``serving.cow_copies`` /
+``serving.evictions``; the ``serving.shared_blocks`` gauge lives with
+the allocator.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import profiler
+from .base import MXNetError
+from .kv_cache import BlockAllocator
+
+__all__ = ["PrefixIndex", "PrefixCache"]
+
+EVICT_POLICIES = ("lru", "off")
+
+
+class _Node:
+    """One cached block: the radix-tree edge label is the block's
+    token bytes; the payload is the page id holding its K/V."""
+
+    __slots__ = ("key", "page", "parent", "children", "stamp")
+
+    def __init__(self, key: bytes, page: int, parent):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children: Dict[bytes, "_Node"] = {}
+        self.stamp = 0
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"_Node(page={self.page}, children={len(self.children)})"
+
+
+class PrefixIndex:
+    """Radix tree over block-aligned token prefixes -> page chains.
+
+    Keys are the raw bytes of each ``block_tokens``-sized token block
+    (exact match — no hash collisions to reason about); depth d holds
+    the d-th block of a prefix.  LRU stamps come from a monotonic
+    logical clock, so eviction order is a deterministic function of
+    the request sequence, never of wall time."""
+
+    def __init__(self, block_tokens: int):
+        if block_tokens < 1:
+            raise MXNetError(f"bad block_tokens {block_tokens}")
+        self.block_tokens = int(block_tokens)
+        self._root: Dict[bytes, _Node] = {}
+        self._clock = 0
+        self._nodes = 0
+
+    def __len__(self) -> int:
+        return self._nodes
+
+    def _keys(self, tokens: np.ndarray, nblocks: int) -> List[bytes]:
+        t = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        B = self.block_tokens
+        return [t[j * B:(j + 1) * B].tobytes() for j in range(nblocks)]
+
+    def _touch(self, node: _Node) -> None:
+        self._clock += 1
+        node.stamp = self._clock
+
+    # ------------------------------------------------------------------
+    def match(self, tokens, touch: bool = True) -> List[_Node]:
+        """Longest cached block-aligned prefix of ``tokens``: the node
+        chain, shallowest first (``len(chain) * block_tokens`` cached
+        tokens).  ``touch`` refreshes the chain's LRU stamps."""
+        nblocks = len(tokens) // self.block_tokens
+        chain: List[_Node] = []
+        children = self._root
+        for key in self._keys(tokens, nblocks):
+            node = children.get(key)
+            if node is None:
+                break
+            chain.append(node)
+            children = node.children
+        if touch:
+            for node in chain:  # shallow->deep: deepest gets newest
+                self._touch(node)
+        return chain
+
+    def insert(self, tokens, pages: List[int],
+               nblocks: int) -> List[_Node]:
+        """Map the first ``nblocks`` full blocks of ``tokens`` to
+        ``pages[j]``.  Existing nodes keep THEIR page (the content is
+        identical by construction; the caller's duplicate page simply
+        stays private).  Returns the nodes newly created — whose pages
+        the index now co-owns."""
+        created: List[_Node] = []
+        children = self._root
+        parent: Optional[_Node] = None
+        for j, key in enumerate(self._keys(tokens, nblocks)):
+            node = children.get(key)
+            if node is None:
+                node = _Node(key, int(pages[j]), parent)
+                children[key] = node
+                self._nodes += 1
+                created.append(node)
+            self._touch(node)
+            parent = node
+            children = node.children
+        return created
+
+    def remove(self, node: _Node) -> None:
+        """Unlink a LEAF node (eviction).  Interior nodes cannot go
+        first — their children's chains would dangle."""
+        if node.children:
+            raise MXNetError("PrefixIndex.remove of an interior node")
+        siblings = node.parent.children if node.parent is not None \
+            else self._root
+        if siblings.get(node.key) is not node:  # pragma: no cover
+            raise MXNetError("PrefixIndex.remove of an unlinked node")
+        del siblings[node.key]
+        self._nodes -= 1
+
+    def leaves(self) -> List[_Node]:
+        out = []
+        stack = list(self._root.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            else:
+                out.append(n)
+        return out
+
+
+class PrefixCache:
+    """The sharing layer the engine talks to: allocator + radix index
+    + eviction policy + the hit/COW/eviction counters.
+
+    All page-state transitions used by the serving scheduler flow
+    through here so the invariants hold in one place:
+
+    * ``peek``/``attach`` — longest-prefix lookup at admission;
+      attach bumps refcounts (reviving parked pages) so the matched
+      chain cannot be evicted from under the stream;
+    * ``register`` — after (suffix) prefill, the prompt's full pages
+      enter the index and become shareable;
+    * ``release`` — a retiring/preempted stream detaches; indexed
+      pages park (bytes kept) instead of freeing;
+    * ``alloc`` — pages for new work, evicting parked pages LRU when
+      the free list runs dry;
+    * ``needs_cow`` — the decode-step write probe: true when the
+      target page has other holders or is still index-mapped.
+    """
+
+    def __init__(self, alloc: BlockAllocator, policy: str = "lru"):
+        if policy not in EVICT_POLICIES:
+            raise MXNetError(
+                f"unknown eviction policy {policy!r} "
+                f"(MXNET_SERVING_EVICT wants one of {EVICT_POLICIES})")
+        self.allocator = alloc
+        self.policy = policy
+        self.index = PrefixIndex(alloc.block_tokens)
+        self._page_node: Dict[int, _Node] = {}  # indexed pages
+        self.hits = 0
+        self.hit_tokens = 0
+        self.full_hits = 0
+        self.cow_copies = 0
+        self.evictions = 0
+
+    # -- admission ------------------------------------------------------
+    def peek(self, tokens) -> Tuple[int, int]:
+        """(cached_tokens, parked_matched) for the longest cached
+        prefix — refcounts untouched, stamps untouched (a peek that
+        doesn't admit must not distort LRU order).  ``parked_matched``
+        pages revive on attach, so they are NOT spare capacity for the
+        admission check."""
+        chain = self.index.match(tokens, touch=False)
+        parked = sum(1 for n in chain if self.allocator.is_parked(n.page))
+        return len(chain) * self.index.block_tokens, parked
+
+    def attach(self, tokens, owner=None) -> Tuple[int, List[int]]:
+        """Acquire the longest cached prefix for a new stream: bump
+        each chain page's refcount (reviving parked ones) and return
+        (cached_tokens, pages).  Counted as ONE prefix hit when
+        anything matched."""
+        chain = self.index.match(tokens, touch=True)
+        pages = []
+        for node in chain:
+            if self.allocator.is_parked(node.page):
+                self.allocator.revive(node.page, owner=owner)
+            else:
+                self.allocator.share(node.page)
+            pages.append(node.page)
+        cached = len(chain) * self.index.block_tokens
+        if cached:
+            self.hits += 1
+            self.hit_tokens += cached
+            profiler.inc_counter("serving.prefix_hits")
+            profiler.inc_counter("serving.prefix_hit_tokens", cached)
+        return cached, pages
+
+    # -- registration ---------------------------------------------------
+    def register(self, tokens, pages: List[int]) -> None:
+        """Index every FULL block of ``tokens`` (held by the calling
+        stream as ``pages``).  Blocks already indexed keep the
+        incumbent page; the caller's duplicate stays private."""
+        nblocks = len(tokens) // self.index.block_tokens
+        if nblocks > len(pages):  # pragma: no cover - caller bug
+            raise MXNetError(
+                f"register: {nblocks} full blocks but only "
+                f"{len(pages)} pages")
+        for node in self.index.insert(tokens, pages, nblocks):
+            self._page_node[node.page] = node
+
+    # -- release / eviction ---------------------------------------------
+    def release(self, pages: List[int]) -> None:
+        """A stream detaches from its pages (retire, preemption,
+        failure).  Indexed pages whose refcount hits zero park (bytes
+        kept for future hits) under the 'lru' policy; with 'off' they
+        free immediately and leave the index."""
+        for p in pages:
+            keep = self.policy == "lru" and p in self._page_node
+            left = self.allocator.release(p, park=keep)
+            if left == 0 and not keep and p in self._page_node:
+                self._drop_chain(self._page_node[p])
+
+    def _drop_chain(self, node: _Node) -> None:
+        """Remove a node's whole subtree from the index (policy 'off'
+        release: the page just freed must not stay reachable).
+        Descendant pages still held by live streams merely lose their
+        index entry (they free normally at their own release); parked
+        descendants are reclaimed."""
+        stack = [node]
+        order: List[_Node] = []
+        while stack:
+            n = stack.pop()
+            order.append(n)
+            stack.extend(n.children.values())
+        for n in reversed(order):  # deepest-first: leaves before parents
+            self.index.remove(n)
+            self._page_node.pop(n.page, None)
+            if self.allocator.is_parked(n.page):
+                self.allocator.reclaim(n.page)
+
+    def _evictable(self) -> List[_Node]:
+        """Leaf nodes whose page is parked, LRU-first."""
+        cands = [n for n in self.index.leaves()
+                 if self.allocator.is_parked(n.page)]
+        cands.sort(key=lambda n: n.stamp)
+        return cands
+
+    def evict(self, need: int) -> int:
+        """Reclaim up to ``need`` parked pages in LRU leaf order
+        (evicting a leaf may expose its parent as the next
+        candidate).  Returns the number reclaimed."""
+        if self.policy != "lru":
+            return 0
+        done = 0
+        while done < need:
+            cands = self._evictable()
+            if not cands:
+                break
+            for n in cands:
+                if done >= need:
+                    break
+                self.index.remove(n)
+                del self._page_node[n.page]
+                self.allocator.reclaim(n.page)
+                self.evictions += 1
+                profiler.inc_counter("serving.evictions")
+                done += 1
+        return done
+
+    def alloc(self, n: int, owner=None) -> Optional[List[int]]:
+        """Allocator facade: evict parked pages (LRU) when the free
+        list alone cannot cover ``n``, then allocate all-or-nothing."""
+        short = n - self.allocator.free_list_blocks
+        if short > 0:
+            self.evict(short)
+        return self.allocator.alloc(n, owner=owner)
+
+    # -- copy-on-write ---------------------------------------------------
+    def needs_cow(self, page: int) -> bool:
+        """Would a K/V write to ``page`` be visible beyond its writer?
+        True when another stream holds it, or the index still maps its
+        bytes (a future hit would read the overwrite)."""
+        return self.allocator.refcount(page) > 1 or page in self._page_node
+
+    def note_cow(self) -> None:
+        self.cow_copies += 1
+        profiler.inc_counter("serving.cow_copies")
+
+    def reset_counters(self) -> None:
+        """Zero the hit/COW/eviction counters (bench sweep points);
+        the index and page states are untouched."""
+        self.hits = self.hit_tokens = self.full_hits = 0
+        self.cow_copies = self.evictions = 0
+
+    # -- introspection ---------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "prefix_hits": self.hits,
+            "prefix_hit_tokens": self.hit_tokens,
+            "prefix_full_hits": self.full_hits,
+            "cow_copies": self.cow_copies,
+            "evictions": self.evictions,
+            "indexed_blocks": len(self.index),
+            "cached_blocks": self.allocator.parked_blocks,
+            "shared_blocks": self.allocator.shared_blocks,
+        }

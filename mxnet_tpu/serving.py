@@ -848,6 +848,19 @@ def _read_env_int(name, lo=1):
     return v
 
 
+def _read_env_str(name, choices=None):
+    """String env var resolved through the config catalog, optionally
+    validated against a closed vocabulary (loud at construction)."""
+    from . import config
+
+    raw = get_env(name, None, str)
+    if raw is None:
+        raw = config.describe(name).default
+    if choices is not None and raw not in choices:
+        raise MXNetError(f"{name}={raw!r} must be one of {choices}")
+    return raw
+
+
 def _read_env_buckets(name, default):
     """CSV bucket ladder: strictly increasing positive ints."""
     raw = get_env(name, None, str)
@@ -870,7 +883,8 @@ class _Stream:
 
     __slots__ = ("sid", "prompt", "max_new", "temp", "eos", "future",
                  "seed", "generated", "blocks", "length", "next_token",
-                 "resume", "t_submit", "t_admit", "trace", "t_enqueue")
+                 "resume", "t_submit", "t_admit", "trace", "t_enqueue",
+                 "cached_len", "await_first")
 
     def __init__(self, sid, prompt, max_new, temp, eos, future, seed,
                  trace=None):
@@ -890,6 +904,8 @@ class _Stream:
         self.t_admit = 0.0
         self.t_enqueue = self.t_submit  # (re)joined the pending queue
         self.trace = trace            # TraceContext | None
+        self.cached_len = 0           # prefix-cache tokens attached
+        self.await_first = False      # full hit: first token pending
 
     def prefill_seq(self) -> np.ndarray:
         """Token sequence whose K/V the cache must hold before the
@@ -973,16 +989,46 @@ class DecodeEngine:
                  decode_buckets=None, cache_buckets=None,
                  prefill_buckets=None, temperature=0.0, seed=0,
                  eos_id=None, ctx=None, donate=None, dtype="float32",
+                 kv_dtype=None, prefix_cache=None, evict_policy=None,
                  prewarm=False):
         import jax
 
-        from .kv_cache import BlockAllocator, blocks_for_tokens, \
-            bucket_ladder
+        from .kv_cache import (BlockAllocator, blocks_for_tokens,
+                               bucket_ladder, kv_quantized,
+                               kv_storage_dtype)
         from .executor import build_graph_fn
-        from .models.transformer import transformer_lm_decode, \
-            transformer_lm_prefill
+        from .models.transformer import (transformer_lm_decode,
+                                         transformer_lm_prefill,
+                                         transformer_lm_prefix_prefill)
+        from .prefix_cache import EVICT_POLICIES, PrefixCache
+        from .kv_cache import KV_DTYPES
 
         self._blocks_for = blocks_for_tokens
+
+        # -- prefix cache / KV storage configuration --------------------
+        # (loud at-construction validation, the MXNET_CKPT_* pattern)
+        self._kv_dtype = kv_dtype if kv_dtype is not None else \
+            _read_env_str("MXNET_SERVING_KV_DTYPE", choices=KV_DTYPES)
+        if self._kv_dtype not in KV_DTYPES:
+            raise MXNetError(
+                f"kv_dtype {self._kv_dtype!r} must be one of {KV_DTYPES}")
+        self._quant = kv_quantized(self._kv_dtype)
+        kv_store_dtype = kv_storage_dtype(self._kv_dtype)  # may raise
+        if prefix_cache is None:
+            prefix_cache = _read_env_int("MXNET_SERVING_PREFIX_CACHE",
+                                         lo=0)
+        if int(prefix_cache) not in (0, 1):
+            raise MXNetError(
+                f"MXNET_SERVING_PREFIX_CACHE={prefix_cache!r} must be "
+                f"0 or 1")
+        self._prefix_on = bool(int(prefix_cache))
+        self._evict_policy = evict_policy if evict_policy is not None \
+            else _read_env_str("MXNET_SERVING_EVICT",
+                               choices=EVICT_POLICIES)
+        if self._evict_policy not in EVICT_POLICIES:
+            raise MXNetError(
+                f"MXNET_SERVING_EVICT={self._evict_policy!r} must be "
+                f"one of {EVICT_POLICIES}")
         self._vocab = int(vocab_size)
         self._L = int(num_layers)
         self._H = int(num_heads)
@@ -1034,6 +1080,9 @@ class DecodeEngine:
         if int(cache_blocks) < 2:
             raise MXNetError(f"cache_blocks {cache_blocks} must be >= 2")
         self._alloc = BlockAllocator(int(cache_blocks), self._kv_block)
+        self._prefix = PrefixCache(self._alloc,
+                                   policy=self._evict_policy) \
+            if self._prefix_on else None
 
         # -- bucket ladders ---------------------------------------------
         self._decode_buckets = tuple(
@@ -1077,14 +1126,24 @@ class DecodeEngine:
         # -- graphs + pools ---------------------------------------------
         kw = dict(vocab_size=vocab_size, num_layers=num_layers,
                   num_heads=num_heads, d_model=d_model, d_ff=d_ff,
-                  kv_block=self._kv_block, paged=True)
+                  kv_block=self._kv_block, paged=True,
+                  kv_dtype=self._kv_dtype)
         dec_sym = transformer_lm_decode(**kw)
         pre_sym = transformer_lm_prefill(**kw)
         self._dec_gfn = build_graph_fn(dec_sym)
         self._pre_gfn = build_graph_fn(pre_sym)
-        feed = {"data", "positions", "lengths", "block_table"}
+        self._pfx_gfn = None
+        if self._prefix_on:
+            pkw = dict(kw)
+            pkw.pop("paged")
+            self._pfx_gfn = build_graph_fn(
+                transformer_lm_prefix_prefill(**pkw))
+        feed = {"data", "positions", "lengths", "block_table", "start"}
         feed |= {f"layer{i}_{t}pool" for i in range(self._L)
                  for t in "kv"}
+        if self._quant:
+            feed |= {f"layer{i}_{t}scale" for i in range(self._L)
+                     for t in "kv"}
         self._param_names = [n for n in dec_sym.list_arguments()
                              if n not in feed]
         missing = [n for n in self._param_names if n not in host_params]
@@ -1093,15 +1152,30 @@ class DecodeEngine:
                              f"decode graph")
         self._params = {n: to_dev(host_params[n])
                         for n in self._param_names}
-        self._np_dtype = np.dtype(dtype)
+        # pool STORAGE dtype: the legacy ``dtype`` arg for fp32 (it
+        # always meant the pool dtype), the kv_dtype mapping otherwise
+        self._np_dtype = np.dtype(dtype) if self._kv_dtype == "fp32" \
+            else kv_store_dtype
+        # per-layer pool stride in self._pools: [k, v] or, quantized,
+        # [k, v, k_scale, v_scale]
+        self._pool_stride = 4 if self._quant else 2
         pool_shape = (int(cache_blocks), self._kv_block, self._H,
                       self._D)
         pool_zero = np.zeros(pool_shape, self._np_dtype)
-        self._pools = tuple(jax.device_put(pool_zero, dev)
-                            for _ in range(2 * self._L))
-        self._pool_bytes = 2 * self._L * int(np.prod(pool_shape)) \
-            * self._np_dtype.itemsize
+        scale_one = np.ones(pool_shape[:3], np.float32)
+        pools = []
+        for _ in range(self._L):
+            pools.append(jax.device_put(pool_zero, dev))
+            pools.append(jax.device_put(pool_zero, dev))
+            if self._quant:
+                pools.append(jax.device_put(scale_one, dev))
+                pools.append(jax.device_put(scale_one, dev))
+        self._pools = tuple(pools)
+        self._pool_bytes = sum(int(np.prod(np.shape(p)))
+                               * np.dtype(p.dtype).itemsize
+                               for p in self._pools)
         profiler.set_gauge("serving.kv_pool_bytes", self._pool_bytes)
+        self._cow_fn = None  # lazily-jitted copy-on-write page copy
 
         if donate is None:
             donate = jax.default_backend() != "cpu"
@@ -1282,6 +1356,14 @@ class DecodeEngine:
         for bb in self._decode_buckets:
             for mb in self._cache_buckets:
                 self._decode_exe(bb, mb)
+        if self._prefix is not None:
+            # suffix-prefill matrix: a table bucket narrower than the
+            # suffix itself can never occur (the table covers prefix +
+            # suffix pages), so those combinations are skipped
+            for tp in self._prefill_buckets:
+                for mb in self._cache_buckets:
+                    if mb * self._kv_block >= tp:
+                        self._prefix_prefill_exe(tp, mb)
 
     def _count(self, name, value=1.0):
         self._metrics.inc(name, value)
@@ -1293,6 +1375,8 @@ class DecodeEngine:
         :meth:`stats` covers only work from this point on (benchmarks
         isolate sweep points; lifetime percentiles blend loads)."""
         self._metrics.reset()
+        if self._prefix is not None:
+            self._prefix.reset_counters()
 
     def stats(self) -> dict:
         summ = self._metrics.summary()
@@ -1306,9 +1390,23 @@ class DecodeEngine:
         out["p99_ms"] = tpt["p99"] if tpt else None
         ttft = summ["histograms"].get("ttft_ms")
         out["ttft_p50_ms"] = ttft["p50"] if ttft else None
+        for split in ("ttft_hit_ms", "ttft_miss_ms"):
+            h = summ["histograms"].get(split)
+            out[split.replace("_ms", "_p50_ms")] = h["p50"] if h \
+                else None
         out["tokens_per_s"] = summ["rates"].get("tokens", 0.0)
         out["cache_util"] = self._alloc.utilization()
         out["cache_blocks_free"] = self._alloc.free_blocks
+        out["cache_blocks_cached"] = self._alloc.parked_blocks
+        out["shared_blocks"] = self._alloc.shared_blocks
+        out["kv_dtype"] = self._kv_dtype
+        out["prefix_cache"] = int(self._prefix_on)
+        if self._prefix is not None:
+            out.update(self._prefix.stats())
+            admissions = out["prefills"] + self._prefix.full_hits
+            out["prefix_hit_rate"] = round(
+                self._prefix.hits / admissions, 4) if admissions \
+                else 0.0
         with self._lock:
             out["active_streams"] = len(self._active)
             out["pending"] = len(self._pending)
@@ -1321,7 +1419,9 @@ class DecodeEngine:
             summ, {"queue_wait": "queue_wait_ms",
                    "prefill": "prefill_ms",
                    "decode": "time_per_token_ms",
-                   "ttft": "ttft_ms"})
+                   "ttft": "ttft_ms",
+                   "ttft_hit": "ttft_hit_ms",
+                   "ttft_miss": "ttft_miss_ms"})
         return out
 
     # ------------------------------------------------------------------
@@ -1370,7 +1470,7 @@ class DecodeEngine:
             self._pending, self._active = [], []
         for s in streams:
             if s.blocks:
-                self._alloc.free(s.blocks)
+                self._release_pages(s.blocks)
                 s.blocks = []
             if s.future.set_running_or_notify_cancel():
                 s.future.set_exception(exc)
@@ -1428,9 +1528,7 @@ class DecodeEngine:
                 args = dict(params)
                 args.update(data=tokens, positions=positions,
                             lengths=lengths, block_table=table)
-                for i in range(L):
-                    args[f"layer{i}_kpool"] = pools[2 * i]
-                    args[f"layer{i}_vpool"] = pools[2 * i + 1]
+                self._pool_args(args, pools)
                 outs, _ = gfn(args, {}, gkey, False)
                 toks = self._sample(outs[0][:, 0, :], temps, seeds,
                                     steps)
@@ -1478,9 +1576,7 @@ class DecodeEngine:
                 args = dict(params)
                 args.update(data=tokens, positions=positions,
                             lengths=lengths, block_table=table)
-                for i in range(L):
-                    args[f"layer{i}_kpool"] = pools[2 * i]
-                    args[f"layer{i}_vpool"] = pools[2 * i + 1]
+                self._pool_args(args, pools)
                 outs, _ = gfn(args, {}, gkey, False)
                 logits = outs[0]          # (1, Tp, V)
                 last = logits[jnp.arange(logits.shape[0]),
@@ -1507,6 +1603,108 @@ class DecodeEngine:
             self._exe_cache[key] = exe
             self.compiles[key] = self.compiles.get(key, 0) + 1
             return exe
+
+    def _pool_args(self, args, pools):
+        """Bind the flat pools tuple into graph args — per-layer
+        stride 2 ([k, v]) or 4 ([k, v, k_scale, v_scale])."""
+        st = self._pool_stride
+        for i in range(self._L):
+            args[f"layer{i}_kpool"] = pools[st * i]
+            args[f"layer{i}_vpool"] = pools[st * i + 1]
+            if self._quant:
+                args[f"layer{i}_kscale"] = pools[st * i + 2]
+                args[f"layer{i}_vscale"] = pools[st * i + 3]
+        return args
+
+    def _prefix_prefill_exe(self, tp: int, mb: int):
+        """Suffix-prefill executable for a prefix-cache hit: suffix
+        padded to ``tp`` tokens, block table padded to ``mb`` pages
+        (prefix + suffix chains)."""
+        key = ("prefix_prefill", tp, mb)
+        exe = self._exe_cache.get(key)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._exe_cache.get(key)
+            if exe is not None:
+                return exe
+            import jax
+            import jax.numpy as jnp
+
+            gfn, L = self._pfx_gfn, self._L
+            gkey = self._graph_key
+
+            def prefill(params, tokens, positions, start, lengths,
+                        table, temps, seeds, steps, pools):
+                args = dict(params)
+                args.update(data=tokens, positions=positions,
+                            start=start, lengths=lengths,
+                            block_table=table)
+                self._pool_args(args, pools)
+                outs, _ = gfn(args, {}, gkey, False)
+                logits = outs[0]          # (1, Ts, V) — SUFFIX rows
+                last = logits[jnp.arange(logits.shape[0]),
+                              lengths - start - 1]
+                toks = self._sample(last, temps, seeds, steps)
+                return toks, tuple(outs[1:])
+
+            i32 = np.dtype(np.int32)
+            specs = (self._spec_of(self._params),
+                     jax.ShapeDtypeStruct((1, tp), i32),
+                     jax.ShapeDtypeStruct((1, tp), i32),
+                     jax.ShapeDtypeStruct((1,), i32),
+                     jax.ShapeDtypeStruct((1,), i32),
+                     jax.ShapeDtypeStruct((1, mb), i32),
+                     jax.ShapeDtypeStruct((1,), np.dtype(np.float32)),
+                     jax.ShapeDtypeStruct((1,), i32),
+                     jax.ShapeDtypeStruct((1,), i32),
+                     self._spec_of(self._pools))
+            with profiler.scope(
+                    f"serving.compile.prefix_prefill.t{tp}x{mb}",
+                    "serving", args={"tokens": tp, "blocks": mb}):
+                jitted = jax.jit(
+                    prefill,
+                    donate_argnums=(9,) if self._donate else ())
+                exe = jitted.lower(*specs).compile()
+            self._exe_cache[key] = exe
+            self.compiles[key] = self.compiles.get(key, 0) + 1
+            return exe
+
+    def _cow_exe(self):
+        """One jitted page copy for copy-on-write: every pool (values
+        and scales) copies row ``src`` into row ``dst``; src/dst are
+        traced scalars, so this compiles exactly once."""
+        if self._cow_fn is None:
+            import jax
+
+            def copy(pools, src, dst):
+                return tuple(p.at[dst].set(p[src]) for p in pools)
+
+            jitted = jax.jit(
+                copy, donate_argnums=(0,) if self._donate else ())
+            self._cow_fn = jitted
+        return self._cow_fn
+
+    # ------------------------------------------------------------------
+    # page accounting: the alloc/release funnel (prefix-aware)
+    # ------------------------------------------------------------------
+    def _palloc(self, n: int, owner=None):
+        """Allocate pages; with the prefix cache on, parked (cached)
+        pages are evicted LRU when the free list runs dry."""
+        if self._prefix is not None:
+            return self._prefix.alloc(n, owner=owner)
+        return self._alloc.alloc(n, owner=owner)
+
+    def _release_pages(self, pages):
+        """Detach a stream from its pages.  Exclusive pages free;
+        shared pages drop one reference; indexed pages park for future
+        prefix hits."""
+        if not pages:
+            return
+        if self._prefix is not None:
+            self._prefix.release(pages)
+        else:
+            self._alloc.free(pages)
 
     # ------------------------------------------------------------------
     # scheduler
@@ -1558,7 +1756,16 @@ class DecodeEngine:
         capped at the stream's LIFETIME page need (a request whose
         prefill already holds every page it will ever touch needs no
         headroom, and one sized exactly to the pool must still be
-        admittable)."""
+        admittable).
+
+        With the prefix cache on, the longest cached block-aligned
+        prefix of the prompt is ATTACHED (block-table splice — pages
+        shared by refcount, parked pages revived) and only the suffix
+        needs new pages + prefill.  A fully-cached prompt skips
+        prefill entirely: the stream enters decode replaying its last
+        prompt token (whose page write COWs at the first step).
+        Matched-but-parked pages are about to be revived, so they do
+        NOT count as spare capacity for the admission check."""
         while True:
             with self._lock:
                 if not self._pending \
@@ -1566,52 +1773,147 @@ class DecodeEngine:
                     return
                 s = self._pending[0]
                 seq = s.prefill_seq()
-                need = self._blocks_for(max(len(seq), 1),
-                                        self._kv_block)
+                if self._prefix is not None:
+                    cached, parked_matched = self._prefix.peek(seq)
+                else:
+                    cached, parked_matched = 0, 0
+                # cached is block-aligned, so the suffix page count is
+                # exactly the total minus the attached chain — the
+                # fully-cached prompt is the 0-token path:
+                # blocks_for_tokens(0) == 0 new pages
+                if cached:
+                    need = self._blocks_for(len(seq) - cached,
+                                            self._kv_block)
+                else:
+                    need = self._blocks_for(max(len(seq), 1),
+                                            self._kv_block)
                 lifetime = self._blocks_for(
                     len(s.prompt) + s.max_new, self._kv_block)
-                if self._alloc.free_blocks < min(need + 1, lifetime):
+                lifetime_new = max(
+                    lifetime - cached // self._kv_block, 0)
+                avail = self._alloc.free_blocks - parked_matched
+                if avail < min(need + 1, max(lifetime_new, 1)):
                     return  # not enough cache: hold the FIFO line
                 self._pending.pop(0)
                 self._admitting = s  # visible to _fail_outstanding
             # On failure _admitting must STAY set until the loop's
             # poison handler runs — clearing it first would strand the
             # caller's future between pop and activation.
-            pages = self._alloc.alloc(need, owner=s.sid)
+            if self._prefix is not None:
+                cached, pages = self._prefix.attach(seq, owner=s.sid)
+            else:
+                cached, pages = 0, []
             s.blocks = pages  # attach now: a dying prefill must not leak
-            self._prefill(s, seq, pages)
+            s.cached_len = cached
+            new_pages = self._palloc(need, owner=s.sid)
+            if new_pages is None:  # pragma: no cover - defensive
+                raise MXNetError(
+                    f"admission raced the allocator: {need} pages "
+                    f"unavailable after the capacity check")
+            s.blocks = pages + new_pages
+            if cached == len(seq) and cached > 0:
+                self._full_hit(s, seq)
+            else:
+                self._prefill(s, seq, s.blocks)
             self._admitting = None
+
+    def _full_hit(self, s: _Stream, seq: np.ndarray):
+        """Admission of a fully-cached prompt: NO prefill runs.  A
+        fresh stream re-enters decode at its last prompt token — the
+        step recomputes that token's K/V (the write COWs the shared
+        tail page) and samples the first new token, so TTFT is one
+        decode step.  A resumed stream's pending next_token survives,
+        so it continues exactly where preemption cut it."""
+        n = len(seq)
+        if self._prefix is not None:
+            self._prefix.full_hits += 1
+        if s.resume:
+            s.length = n          # cache holds all of seq
+            s.resume = False      # next_token survives preemption
+        else:
+            s.length = n - 1      # replay the last prompt token
+            s.next_token = int(seq[-1])
+            s.await_first = True  # first token (and TTFT) at step 1
+        now = time.perf_counter()
+        wait_ms = (now - s.t_enqueue) * 1e3
+        self._metrics.observe("queue_wait_ms", wait_ms)
+        profiler.observe("serving.queue_wait_ms", wait_ms)
+        if s.trace is not None:
+            profiler.add_trace_event(
+                "serving.queue", s.t_enqueue, now - s.t_enqueue,
+                s.trace.child(), cat="serving",
+                args={"sid": s.sid, "full_hit": True})
+        s.t_admit = now
+        with self._lock:
+            self._active.append(s)
 
     def _prefill(self, s: _Stream, seq: np.ndarray, pages: List[int]):
         from .io import stage_array
 
         n = len(seq)
-        tp = self._bucket(self._prefill_buckets, n, "prompt length")
-        mb = tp // self._kv_block
-        exe = self._prefill_exe(tp)
-        tokens = np.zeros((1, tp), np.int32)
-        tokens[0, :n] = seq
-        positions = np.arange(tp, dtype=np.int32)[None]
-        lengths = np.asarray([n], np.int32)
-        table = np.zeros((1, mb), np.int32)
-        table[0, :len(pages)] = pages
+        c = s.cached_len  # block-aligned prefix already in the cache
+        dev = self._device
         temps = np.asarray([s.temp], np.float32)
         seeds = np.asarray([s.seed], np.int32)
         steps = np.asarray([n - 1], np.int32)  # sampling position
-        dev = self._device
         t_pre0 = time.perf_counter()
-        with profiler.scope(f"serving.prefill.t{tp}", "serving",
-                            args={"tokens": n, "bucket": tp,
-                                  "resume": s.resume}):
-            toks, self._pools = exe(
-                self._params, stage_array(tokens, dev),
-                stage_array(positions, dev), stage_array(lengths, dev),
-                stage_array(table, dev), stage_array(temps, dev),
-                stage_array(seeds, dev), stage_array(steps, dev),
-                self._pools)
-            first = int(np.asarray(toks)[0])
+        if c:
+            # prefix hit: prefill ONLY the uncached suffix, attending
+            # the shared prefix through the block table
+            ns = n - c
+            tp = self._bucket(self._prefill_buckets, ns,
+                              "suffix length")
+            mb = self._bucket(self._cache_buckets, len(pages),
+                              "cache blocks")
+            exe = self._prefix_prefill_exe(tp, mb)
+            tokens = np.zeros((1, tp), np.int32)
+            tokens[0, :ns] = seq[c:]
+            positions = (c + np.arange(tp, dtype=np.int32))[None]
+            start = np.asarray([c], np.int32)
+            lengths = np.asarray([n], np.int32)
+            table = np.zeros((1, mb), np.int32)
+            table[0, :len(pages)] = pages
+            with profiler.scope(f"serving.prefill.suffix.t{tp}",
+                                "serving",
+                                args={"tokens": ns, "cached": c,
+                                      "bucket": tp,
+                                      "resume": s.resume}):
+                toks, self._pools = exe(
+                    self._params, stage_array(tokens, dev),
+                    stage_array(positions, dev),
+                    stage_array(start, dev),
+                    stage_array(lengths, dev), stage_array(table, dev),
+                    stage_array(temps, dev), stage_array(seeds, dev),
+                    stage_array(steps, dev), self._pools)
+                first = int(np.asarray(toks)[0])
+        else:
+            ns = n
+            tp = self._bucket(self._prefill_buckets, n, "prompt length")
+            mb = tp // self._kv_block
+            exe = self._prefill_exe(tp)
+            tokens = np.zeros((1, tp), np.int32)
+            tokens[0, :n] = seq
+            positions = np.arange(tp, dtype=np.int32)[None]
+            lengths = np.asarray([n], np.int32)
+            table = np.zeros((1, mb), np.int32)
+            table[0, :len(pages)] = pages
+            with profiler.scope(f"serving.prefill.t{tp}", "serving",
+                                args={"tokens": n, "bucket": tp,
+                                      "resume": s.resume}):
+                toks, self._pools = exe(
+                    self._params, stage_array(tokens, dev),
+                    stage_array(positions, dev),
+                    stage_array(lengths, dev),
+                    stage_array(table, dev), stage_array(temps, dev),
+                    stage_array(seeds, dev), stage_array(steps, dev),
+                    self._pools)
+                first = int(np.asarray(toks)[0])
         s.blocks = pages
         s.length = n
+        if self._prefix is not None:
+            # the prompt's full pages become shareable; blocks already
+            # indexed keep the incumbent page (ours stays private)
+            self._prefix.register(s.prompt, s.blocks)
         t_done = time.perf_counter()
         prefill_ms = (t_done - t_pre0) * 1e3
         self._metrics.observe("prefill_ms", prefill_ms)
@@ -1639,30 +1941,42 @@ class DecodeEngine:
         else:
             s.next_token = first
             s.generated.append(first)
+            s.await_first = False  # first token delivered via prefill
             ttft = (s.t_admit - s.t_submit) * 1e3
             self._metrics.observe("ttft_ms", ttft)
             profiler.observe("serving.ttft_ms", ttft)
+            # hit/miss TTFT split: a hit's first token cost only the
+            # suffix prefill — the headline prefix-cache latency win
+            split = "ttft_hit_ms" if c else "ttft_miss_ms"
+            self._metrics.observe(split, ttft)
+            profiler.observe(f"serving.{split}", ttft)
             self._count("tokens")
         self._count("prefills")
-        self._count("prefill_tokens", n)
+        self._count("prefill_tokens", ns)  # uncached tokens only
         if s.done():  # max_new == 1 or instant eos
             self._retire(s)
         else:
             with self._lock:
                 self._active.append(s)
 
-    def _ensure_capacity(self, s: _Stream) -> bool:
-        """Grow ``s`` by one token's page if needed; preempt the
-        youngest other stream when the pool is exhausted.  False when
-        ``s`` itself could not be kept resident."""
-        if self._blocks_for(s.length + 1, self._kv_block) \
-                <= len(s.blocks):
-            return True
+    def _reclaimable(self, v: _Stream) -> int:
+        """Pages preempting ``v`` would actually return to the pool:
+        the ones ``v`` holds exclusively (a shared page only loses one
+        reference — its co-holders keep it resident)."""
+        if self._prefix is None:
+            return len(v.blocks)
+        return sum(1 for p in v.blocks
+                   if self._alloc.refcount(p) == 1)
+
+    def _alloc_with_preempt(self, s: _Stream,
+                            n: int) -> Optional[List[int]]:
+        """Pages for active stream ``s``, preempting the youngest
+        other stream when the pool (including evictable cached pages)
+        is exhausted.  None: ``s`` itself was failed and removed."""
         while True:
-            pages = self._alloc.alloc(1, owner=s.sid)
+            pages = self._palloc(n, owner=s.sid)
             if pages is not None:
-                s.blocks.extend(pages)
-                return True
+                return pages
             # a victim must be able to COME BACK: its resume
             # re-prefill (prompt + progress = its cached tokens) has
             # to fit the prefill ladder
@@ -1671,7 +1985,7 @@ class DecodeEngine:
             if not victims:
                 with self._lock:
                     self._active.remove(s)
-                self._alloc.free(s.blocks)
+                self._release_pages(s.blocks)
                 s.blocks = []
                 if s.future.set_running_or_notify_cancel():
                     s.future.set_exception(MXNetError(
@@ -1682,17 +1996,77 @@ class DecodeEngine:
                         f"{self._prefill_buckets[-1]} tokens); size "
                         f"cache_blocks / the prefill ladder for the "
                         f"workload"))
-                return False
-            victim = max(victims, key=lambda v: v.t_admit)
+                return None
+            # prefer victims whose preemption actually frees pages: a
+            # pure sharer only drops refcounts, so evicting it first
+            # is N-1 pointless re-prefills before anything returns to
+            # the pool.  When EVERY victim is a pure sharer, fall back
+            # to the youngest anyway — successive preemptions drain
+            # the chain's refcount to zero, park it, and the eviction
+            # path reclaims it (liveness preserved).
+            productive = [v for v in victims
+                          if self._reclaimable(v) > 0]
+            victim = max(productive or victims,
+                         key=lambda v: v.t_admit)
             self._preempt(victim)
+
+    def _ensure_capacity(self, s: _Stream) -> bool:
+        """Grow ``s`` by one token's page if needed; preempt the
+        youngest other stream when the pool is exhausted.  False when
+        ``s`` itself could not be kept resident."""
+        if self._blocks_for(s.length + 1, self._kv_block) \
+                <= len(s.blocks):
+            return True
+        pages = self._alloc_with_preempt(s, 1)
+        if pages is None:
+            return False
+        s.blocks.extend(pages)
+        return True
+
+    def _maybe_cow(self, s: _Stream) -> bool:
+        """Copy-on-write probe before this step's cache write: if the
+        page about to receive position ``s.length``'s K/V is shared
+        (another stream holds it, or the prefix index still maps its
+        bytes), copy it to a private page on device and splice the
+        block table.  The only route here in practice is a fully-
+        cached prompt replaying its last token — every other write
+        lands on a page that is private by construction (the index
+        holds only FULL pages, so a partial tail is never shared).
+        False when ``s`` could not get its private copy."""
+        j = s.length // self._kv_block
+        if j >= len(s.blocks):  # pragma: no cover - ensured upstream
+            return True
+        page = s.blocks[j]
+        if not self._prefix.needs_cow(page):
+            return True
+        pages = self._alloc_with_preempt(s, 1)
+        if pages is None:
+            return False
+        new = pages[0]
+        with profiler.scope("serving.cow_copy", "serving",
+                            args={"sid": s.sid, "src": page,
+                                  "dst": new}):
+            self._pools = self._cow_exe()(
+                self._pools, np.int32(page), np.int32(new))
+        s.blocks[j] = new
+        self._prefix.release([page])  # drop OUR ref; sharers keep it
+        self._prefix.note_cow()
+        return True
 
     def _preempt(self, victim: _Stream):
         """Recompute-style preemption: drop the victim's pages, requeue
-        it (front of the line) for re-prefill of prompt + progress."""
-        self._alloc.free(victim.blocks)
+        it (front of the line) for re-prefill of prompt + progress.
+        Shared pages lose only the victim's reference — sharers keep
+        reading them, and the victim's re-admission will usually
+        re-attach them as a prefix hit."""
+        self._release_pages(victim.blocks)
         victim.blocks = []
         victim.length = 0
-        victim.resume = True
+        victim.cached_len = 0
+        # a full-hit stream preempted BEFORE its first sampled token
+        # re-admits as a fresh request (there is no pending progress
+        # to resume; prefill_seq would otherwise drop the last token)
+        victim.resume = bool(victim.generated)
         victim.t_enqueue = time.perf_counter()  # re-queued from NOW
         with self._lock:
             self._active.remove(victim)
@@ -1701,7 +2075,7 @@ class DecodeEngine:
 
     def _retire(self, s: _Stream):
         if s.blocks:
-            self._alloc.free(s.blocks)
+            self._release_pages(s.blocks)
             s.blocks = []
         if s.future.set_running_or_notify_cancel():
             s.future.set_result(np.asarray(s.generated, np.int32))
@@ -1714,6 +2088,10 @@ class DecodeEngine:
         for s in list(self._active):
             if s in self._active:
                 self._ensure_capacity(s)
+        if self._prefix is not None:
+            for s in list(self._active):
+                if s in self._active:
+                    self._maybe_cow(s)
         with self._lock:
             streams = list(self._active)
         if not streams:
@@ -1763,6 +2141,15 @@ class DecodeEngine:
             s.generated.append(tok)
             s.length += 1
             s.next_token = tok
+            if s.await_first:
+                # fully-cached prompt: the first token came from this
+                # decode step — TTFT collapsed to one step's wall
+                s.await_first = False
+                ttft = (t_done - s.t_submit) * 1e3
+                self._metrics.observe("ttft_ms", ttft)
+                profiler.observe("serving.ttft_ms", ttft)
+                self._metrics.observe("ttft_hit_ms", ttft)
+                profiler.observe("serving.ttft_hit_ms", ttft)
             self._metrics.observe("time_per_token_ms", step_ms)
             profiler.observe("serving.time_per_token_ms", step_ms)
             if s.trace is not None:
