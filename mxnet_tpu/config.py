@@ -422,6 +422,43 @@ register_env(
     "still-running streams).  Unknown values raise at engine "
     "construction.")
 register_env(
+    "MXNET_SERVING_SPEC_TOKENS", 0, int,
+    "Speculative-decoding draft depth k for serving.DecodeEngine: "
+    "0 (default) decodes one token per stream per step; k >= 1 asks "
+    "the proposer (MXNET_SERVING_PROPOSER) for up to k draft tokens "
+    "per scheduling step and the target model scores pending + drafts "
+    "in ONE multi-query verify step (QKVPagedVerifyAttend), "
+    "committing the longest verified prefix plus one bonus token — "
+    "up to k+1 tokens per step.  Greedy output is bit-identical to "
+    "non-speculative decode; temperature sampling stays exactly the "
+    "target distribution via rejection sampling keyed by the "
+    "existing (seed, stream, position) sampler.  Negative or garbage "
+    "values raise at engine construction.")
+register_env(
+    "MXNET_SERVING_PROPOSER", "ngram", str,
+    "Draft proposer for speculative decoding (used when "
+    "MXNET_SERVING_SPEC_TOKENS > 0): 'ngram' (default) is model-free "
+    "prompt-lookup self-drafting — match the stream's trailing "
+    "n-gram against its own prompt+output history and propose the "
+    "continuation of the most recent earlier occurrence "
+    "(deterministic, so fleet decode retries re-propose "
+    "identically).  The interface (mxnet_tpu.speculative.Proposer-"
+    "style propose(context, k)) is pluggable for a small draft LM; "
+    "unknown names raise at engine construction.")
+register_env(
+    "MXNET_SERVING_PREFILL_CHUNK", 0, int,
+    "Chunked-prefill slice size in TOKENS for serving.DecodeEngine "
+    "(Sarathi-style): 0 (default) prefills each admitted prompt "
+    "monolithically; N > 0 (a multiple of MXNET_SERVING_KV_BLOCK) "
+    "splits prompts whose uncached suffix exceeds N into N-token "
+    "suffix-prefill continuations interleaved with decode steps at "
+    "iteration boundaries, so one long admission no longer stalls "
+    "every active stream's token cadence (admission charges cache "
+    "pages incrementally per chunk).  Chunked prefill is "
+    "bit-identical (lax path, fp32 pools) to monolithic prefill.  "
+    "Negative, garbage, or non-multiple-of-kv_block values raise at "
+    "engine construction.")
+register_env(
     "MXNET_FLEET_REPLICAS", 2, int,
     "Replica-process count for fleet.launch_local_fleet / "
     "tools/bench_fleet.py when none is given explicitly.  Each replica "

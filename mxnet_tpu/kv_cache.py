@@ -49,7 +49,8 @@ from . import profiler
 from .base import MXNetError
 
 __all__ = ["BlockAllocator", "blocks_for_tokens", "bucket_ladder",
-           "kv_storage_dtype", "kv_quantized", "KV_DTYPES", "KV_QMAX"]
+           "trim_blocks", "kv_storage_dtype", "kv_quantized",
+           "KV_DTYPES", "KV_QMAX"]
 
 SCRATCH_PAGE = 0
 
@@ -102,6 +103,24 @@ def blocks_for_tokens(tokens: int, block_tokens: int) -> int:
     if tokens < 0:
         raise MXNetError(f"blocks_for_tokens({tokens}): negative")
     return -(-tokens // int(block_tokens))
+
+
+def trim_blocks(blocks: List[int], tokens: int, block_tokens: int):
+    """Tail-length accounting after a speculative-verify rollback:
+    split a stream's page list into (keep, surplus) where ``keep``
+    covers ``tokens`` cache slots and ``surplus`` is everything past
+    it — pages the verify step allocated for draft tokens that were
+    then rejected.  The surplus pages hold only garbage window writes
+    (every read of them is length-masked, every future write
+    overwrites before any read), so returning them to the pool is
+    safe; callers release them so shared-pool accounting stays
+    truthful mid-generation instead of only at retire.  Page order is
+    positional (page j holds slots [j*B, (j+1)*B)), so the split is a
+    plain prefix split."""
+    keep = blocks_for_tokens(tokens, block_tokens)
+    if keep >= len(blocks):
+        return blocks, []
+    return blocks[:keep], blocks[keep:]
 
 
 def bucket_ladder(max_value: int, base: int = 1) -> List[int]:

@@ -306,6 +306,50 @@ def transformer_lm_prefix_prefill(vocab_size, num_layers=4, num_heads=4,
                      attend_for, vocab_size)
 
 
+def transformer_lm_verify(vocab_size, num_layers=4, num_heads=4,
+                          d_model=128, d_ff=None, kv_block=16,
+                          kv_dtype="fp32"):
+    """Speculative-verify symbol: W = 1 + k tokens per stream per step
+    against the paged KV cache — the multi-query decode step that
+    scores the pending token plus k draft tokens in ONE program.
+
+    Inputs: ``data``/``positions`` (B, W) — the pending token and the
+    drafts at absolute positions ``start[b] + i``; ``start`` (B,)
+    int32 tokens already cached; ``lengths`` (B,) int32 ``start`` +
+    live window rows (rows past it are padding and write to the
+    scratch page); ``block_table`` (B, MB); per-layer pools (+ scale
+    pools when quantized).  Outputs: ``[logits (B, W, vocab)] +
+    [updated caches]``.  Row ``i`` of the logits is bit-identical
+    (lax path) to the single-token decode step at length
+    ``start + 1 + i`` over the same cache bytes — see
+    ``ops.attention.QKVPagedVerifyAttend``."""
+    lengths = sym.Variable("lengths")
+    start = sym.Variable("start")
+    quant = _kv_quant(kv_dtype)
+
+    def attend_for(i):
+        def attend(qkv):
+            if quant:
+                att = sym.QKVPagedVerifyAttendQ(
+                    qkv, sym.Variable(f"layer{i}_kpool"),
+                    sym.Variable(f"layer{i}_vpool"),
+                    sym.Variable(f"layer{i}_kscale"),
+                    sym.Variable(f"layer{i}_vscale"),
+                    sym.Variable("block_table"), start, lengths,
+                    num_heads=num_heads, name=f"layer{i}_attn")
+                return att[0], [att[1], att[2], att[3], att[4]]
+            att = sym.QKVPagedVerifyAttend(
+                qkv, sym.Variable(f"layer{i}_kpool"),
+                sym.Variable(f"layer{i}_vpool"),
+                sym.Variable("block_table"), start, lengths,
+                num_heads=num_heads, name=f"layer{i}_attn")
+            return att[0], [att[1], att[2]]
+        return attend
+
+    return _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block,
+                     attend_for, vocab_size)
+
+
 def transformer_lm_decode(vocab_size, num_layers=4, num_heads=4,
                           d_model=128, d_ff=None, kv_block=16,
                           paged=True, kv_dtype="fp32"):

@@ -47,7 +47,7 @@ def blockwise_attention_partial(q, k, v, causal=False, block_size=512,
 
 def _blockwise_attention_partial_lax(q, k, v, causal, block_size,
                                      kv_offset, lengths=None,
-                                     init_state=None):
+                                     init_state=None, diagonal=False):
     """The pure lax.scan formulation — reference semantics and the
     remat backward for the Pallas forward.
 
@@ -66,7 +66,17 @@ def _blockwise_attention_partial_lax(q, k, v, causal, block_size,
     empty state — chaining two calls scans their blocks as one
     sequence, so splitting a key range across calls (cached prefix
     pages, then raw suffix K/V — the prefix-cache suffix prefill) is
-    bit-identical to a single scan over the concatenation."""
+    bit-identical to a single scan over the concatenation.
+
+    ``diagonal`` (with ``lengths``): per-QUERY visibility — query row
+    ``i`` sees ``k_pos < lengths[b] + i`` instead of one limit per
+    stream.  This is the speculative-verify mask: W queries at
+    absolute positions ``start[b] + i`` each reproduce, row for row,
+    the mask (and therefore the exact online-softmax block chain) of
+    the single-query decode step at length ``lengths[b] + i`` — rows
+    of the blockwise body are arithmetically independent, so one
+    diagonal-masked scan is bit-identical to W sequential decode
+    steps over the same cache bytes."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, q.dtype))
@@ -87,7 +97,11 @@ def _blockwise_attention_partial_lax(q, k, v, causal, block_size,
         k_pos = j * block + jnp.arange(block) + kv_offset
         valid = (j * block + jnp.arange(block)) < Tk  # padding mask
         mask = valid[None, None, None, :]
-        if lengths is not None:
+        if lengths is not None and diagonal:
+            limit = lengths[:, None] + q_pos[None, :]     # (B, Tq)
+            mask = mask & (k_pos[None, None, None, :]
+                           < limit[:, None, :, None])
+        elif lengths is not None:
             mask = mask & (k_pos[None, None, None, :]
                            < lengths[:, None, None, None])
         elif causal:
@@ -822,6 +836,143 @@ def _qkv_paged_prefill_attend_q(op_ctx, attrs, inputs, aux):
     vg = dequantize_kv(new_vp[block_table].reshape(B, MB * KVB, H, D),
                        new_vs[block_table].reshape(B, MB * KVB, H))
     out = prefix_suffix_attention(q, k, v, kg, vg, start, KVB)
+    return [jnp.reshape(out, (B, qkv.shape[1], H * D)), new_kp, new_vp,
+            new_ks, new_vs]
+
+
+# ---------------------------------------------------------------------------
+# Speculative verify: the k-token multi-query decode step.  W = 1 + k
+# queries at absolute positions start[b]..start[b]+W-1 are scored in
+# ONE program — K/V for the whole window is written through the block
+# table first (rows >= lengths[b] route to the scratch page like any
+# padded prefill row), then every query attends the GATHERED cache
+# under the diagonal mask k_pos < start + 1 + row.  Reading the
+# window's own keys back through the pools (quantized pools included)
+# — rather than chaining a raw-suffix scan — is what makes each row
+# bit-identical to the sequential single-query decode step it
+# replaces: the decode path, too, quantizes-then-reads its own token.
+# Rejected tokens' writes are garbage past the accepted length; every
+# later read masks them and every later write overwrites them, the
+# same contract stale page bytes already live under.
+# ---------------------------------------------------------------------------
+
+
+def paged_verify_attention(q, k_pool, v_pool, block_table, start):
+    """Multi-query decode attention for a verify window.
+
+    q (B, W, H, D) at absolute positions ``start[b] + i`` (window K/V
+    already written); returns (B, W, H, D), each row bit-identical
+    (lax path) to the single-query paged decode at length
+    ``start[b] + i + 1`` over the same pool bytes."""
+    from . import pallas_kernels as pk
+
+    KVB = k_pool.shape[1]
+    if pk.enabled():
+        return pk.paged_attention_verify(q, k_pool, v_pool, block_table,
+                                         start)
+    B, MB = block_table.shape
+    H, D = k_pool.shape[2], k_pool.shape[3]
+    kg = k_pool[block_table].reshape(B, MB * KVB, H, D)
+    vg = v_pool[block_table].reshape(B, MB * KVB, H, D)
+    o, m, l = _blockwise_attention_partial_lax(
+        q, kg, vg, False, KVB, 0, lengths=start + 1, diagonal=True)
+    return normalize_attention_state(o, m, l, q.dtype)
+
+
+def paged_verify_attention_q(q, k_pool, v_pool, k_scale, v_scale,
+                             block_table, start):
+    """Quantized-pool verify attention: dequantize the gathered cache
+    (window keys included — matching the quantized decode step, which
+    also reads its own token back through the pools), then run the
+    diagonal-masked blockwise body with fp32 softmax accumulation."""
+    KVB = k_pool.shape[1]
+    B, MB = block_table.shape
+    H, D = k_pool.shape[2], k_pool.shape[3]
+    kg = dequantize_kv(k_pool[block_table].reshape(B, MB * KVB, H, D),
+                       k_scale[block_table].reshape(B, MB * KVB, H))
+    vg = dequantize_kv(v_pool[block_table].reshape(B, MB * KVB, H, D),
+                       v_scale[block_table].reshape(B, MB * KVB, H))
+    o, m, l = _blockwise_attention_partial_lax(
+        q, kg, vg, False, KVB, 0, lengths=start + 1, diagonal=True)
+    return normalize_attention_state(o, m, l, q.dtype)
+
+
+def _qkv_verify_infer(attrs, in_shapes):
+    qkv, kp, vp, bt, st, ln = in_shapes
+    if qkv is None or kp is None:
+        return in_shapes, None, None
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    _check_qkv_packing(qkv[2], H, qkv)
+    return in_shapes, [(qkv[0], qkv[1], qkv[2] // 3), tuple(kp),
+                       tuple(vp if vp is not None else kp)], []
+
+
+@register("QKVPagedVerifyAttend",
+          arg_names=("qkv", "k_pool", "v_pool", "block_table", "start",
+                     "lengths"),
+          out_names=("output", "new_k_pool", "new_v_pool"),
+          infer_shape=_qkv_verify_infer,
+          doc="Speculative-verify decode step over the paged cache: "
+              "qkv (B, W, 3*H*D) holds the pending token plus k draft "
+              "tokens at absolute positions start[b]+i; their K/V is "
+              "written through the block table at that offset (rows "
+              ">= lengths[b] land on the scratch page) and each query "
+              "attends the gathered cache under the diagonal mask "
+              "k_pos < start+1+row — row i bit-identical (lax path) "
+              "to the single-query decode at length start+1+i.  start "
+              "(B,) int32 tokens already cached, lengths (B,) int32 "
+              "start + live window rows; attrs: num_heads")
+def _qkv_paged_verify_attend(op_ctx, attrs, inputs, aux):
+    qkv, k_pool, v_pool, block_table, start, lengths = inputs
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    q, k, v, D = _unpack_qkv(qkv, H)
+    lengths = lengths.astype(jnp.int32)
+    start = start.astype(jnp.int32)
+    block_table = block_table.astype(jnp.int32)
+    new_kp, new_vp = paged_prefill_write(
+        k, v, k_pool, v_pool, block_table, lengths, start=start)
+    out = paged_verify_attention(q, new_kp, new_vp, block_table, start)
+    B = qkv.shape[0]
+    return [jnp.reshape(out, (B, qkv.shape[1], H * D)), new_kp, new_vp]
+
+
+def _qkv_verify_q_infer(attrs, in_shapes):
+    qkv, kp, vp, ks, vs, bt, st, ln = in_shapes
+    if qkv is None or kp is None:
+        return in_shapes, None, None
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    _check_qkv_packing(qkv[2], H, qkv)
+    return in_shapes, [(qkv[0], qkv[1], qkv[2] // 3), tuple(kp),
+                       tuple(vp if vp is not None else kp),
+                       tuple(ks) if ks is not None else None,
+                       tuple(vs) if vs is not None else None], []
+
+
+@register("QKVPagedVerifyAttendQ",
+          arg_names=("qkv", "k_pool", "v_pool", "k_scale", "v_scale",
+                     "block_table", "start", "lengths"),
+          out_names=("output", "new_k_pool", "new_v_pool",
+                     "new_k_scale", "new_v_scale"),
+          infer_shape=_qkv_verify_q_infer,
+          doc="QKVPagedVerifyAttend over QUANTIZED pools: the window "
+              "quantizes on write and every query reads the gathered, "
+              "dequantized cache (its own window keys included — the "
+              "quantized decode step's read path), fp32 softmax "
+              "accumulation; attrs: num_heads")
+def _qkv_paged_verify_attend_q(op_ctx, attrs, inputs, aux):
+    (qkv, k_pool, v_pool, k_scale, v_scale, block_table, start,
+     lengths) = inputs
+    H = attr_int(attrs.get("num_heads", 1), 1)
+    q, k, v, D = _unpack_qkv(qkv, H)
+    lengths = lengths.astype(jnp.int32)
+    start = start.astype(jnp.int32)
+    block_table = block_table.astype(jnp.int32)
+    new_kp, new_vp, new_ks, new_vs = paged_prefill_write_q(
+        k, v, k_pool, v_pool, k_scale, v_scale, block_table, lengths,
+        start=start)
+    out = paged_verify_attention_q(q, new_kp, new_vp, new_ks, new_vs,
+                                   block_table, start)
+    B = qkv.shape[0]
     return [jnp.reshape(out, (B, qkv.shape[1], H * D)), new_kp, new_vp,
             new_ks, new_vs]
 

@@ -1471,3 +1471,108 @@ def paged_attention_decode_quant(q, k_pool, v_pool, k_scale, v_scale,
         interpret=_interpret(),
     )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
       q, k_pool, v_pool, k_scale, v_scale)
+
+
+# ---------------------------------------------------------------------------
+# Speculative-verify paged attention: the k-query variant of the paged
+# decode kernel.  Same grid (B, MB), same one-page-per-step DMA through
+# the scalar-prefetched block table, but W = 1 + k query rows per
+# stream fold into a (H, W, ...) online-softmax state under the
+# DIAGONAL mask k_pos < start[b] + 1 + w — row w reproduces exactly
+# the mask (and block chain) of the single-query decode at length
+# start[b] + 1 + w.  A page fully masked for a row is an exact no-op
+# of that row's state merge (alpha == 1, p == 0), so per-row results
+# match the decode kernel's bit for bit over the same pool bytes.
+# ---------------------------------------------------------------------------
+
+
+def _paged_verify_kernel(table_ref, start_ref, q_ref, k_ref, v_ref,
+                         o_ref, acc_scr, m_scr, l_scr, *, scale, kvb,
+                         nb, w):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    # pages past the window's last visible position hold nothing any
+    # row can see — skip their matmuls entirely
+    @pl.when(j * kvb < start_ref[b] + w)
+    def _compute():
+        q = q_ref[0]                      # (W, H, D)
+        k = k_ref[0]                      # (KVB, H, D)
+        v = v_ref[0]
+        # s[h, w, t] = q[w, h, :] . k[t, h, :]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32) * scale
+        k_pos = j * kvb + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = k_pos < start_ref[b] + 1 + row
+        s = jnp.where(valid, s, -jnp.inf)
+        m_prev = m_scr[:, :, 0]                       # (H, W)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+        m_safe = jnp.where(m_new == -jnp.inf, 0.0, m_new)
+        p = jnp.where(valid, jnp.exp(s - m_safe[:, :, None]), 0.0)
+        alpha = jnp.where(m_prev == -jnp.inf, 0.0,
+                          jnp.exp(m_prev - m_safe))
+        l_scr[...] = jnp.broadcast_to(
+            (l_scr[:, :, 0] * alpha + jnp.sum(p, axis=2))[:, :, None],
+            l_scr.shape)
+        # pv[h, w, d] = sum_t p[h, w, t] * v[t, h, d]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha[:, :, None] + pv
+        m_scr[...] = jnp.broadcast_to(m_new[:, :, None], m_scr.shape)
+
+    @pl.when(j == nb - 1)
+    def _finish():
+        l = l_scr[:, :, 0]                            # (H, W)
+        out = acc_scr[...] / jnp.maximum(l, 1e-30)[:, :, None]
+        o_ref[0] = out.swapaxes(0, 1).astype(o_ref.dtype)   # (W, H, D)
+
+
+def paged_attention_verify(q, k_pool, v_pool, block_table, start):
+    """q (B, W, H, D): the verify window's queries at absolute
+    positions ``start[b] + i`` (window K/V already in the pools);
+    k_pool/v_pool (P, KVB, H, D); block_table (B, MB) int32 page ids
+    (page 0 = scratch); start (B,) int32 tokens cached BEFORE the
+    window -> (B, W, H, D) in q.dtype, row i bit-identical to the
+    single-query decode kernel at length ``start[b] + i + 1``."""
+    B, W, H, D = q.shape
+    P, KVB = k_pool.shape[0], k_pool.shape[1]
+    MB = block_table.shape[1]
+    scale = 1.0 / float(D) ** 0.5
+    kern = functools.partial(_paged_verify_kernel, scale=scale,
+                             kvb=KVB, nb=MB, w=W)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, MB),
+        in_specs=[
+            _vmem_spec((1, W, H, D), lambda b, j, tr, sr: (b, 0, 0, 0)),
+            _vmem_spec((1, KVB, H, D),
+                       lambda b, j, tr, sr: (tr[b, j], 0, 0, 0)),
+            _vmem_spec((1, KVB, H, D),
+                       lambda b, j, tr, sr: (tr[b, j], 0, 0, 0)),
+        ],
+        out_specs=_vmem_spec((1, W, H, D),
+                             lambda b, j, tr, sr: (b, 0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((H, W, D), jnp.float32),
+                        pltpu.VMEM((H, W, 128), jnp.float32),
+                        pltpu.VMEM((H, W, 128), jnp.float32)],
+    )
+    return pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, W, H, D), q.dtype),
+        compiler_params=(pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=100 * 1024 * 1024)
+            if pltpu is not None and not _interpret() else None),
+        interpret=_interpret(),
+    )(block_table.astype(jnp.int32), start.astype(jnp.int32),
+      q, k_pool, v_pool)

@@ -884,7 +884,7 @@ class _Stream:
     __slots__ = ("sid", "prompt", "max_new", "temp", "eos", "future",
                  "seed", "generated", "blocks", "length", "next_token",
                  "resume", "t_submit", "t_admit", "trace", "t_enqueue",
-                 "cached_len", "await_first")
+                 "cached_len", "await_first", "t_chunk0")
 
     def __init__(self, sid, prompt, max_new, temp, eos, future, seed,
                  trace=None):
@@ -906,6 +906,7 @@ class _Stream:
         self.trace = trace            # TraceContext | None
         self.cached_len = 0           # prefix-cache tokens attached
         self.await_first = False      # full hit: first token pending
+        self.t_chunk0 = 0.0           # chunked prefill: first chunk start
 
     def prefill_seq(self) -> np.ndarray:
         """Token sequence whose K/V the cache must hold before the
@@ -990,6 +991,7 @@ class DecodeEngine:
                  prefill_buckets=None, temperature=0.0, seed=0,
                  eos_id=None, ctx=None, donate=None, dtype="float32",
                  kv_dtype=None, prefix_cache=None, evict_policy=None,
+                 spec_tokens=None, proposer=None, prefill_chunk=None,
                  prewarm=False):
         import jax
 
@@ -999,9 +1001,11 @@ class DecodeEngine:
         from .executor import build_graph_fn
         from .models.transformer import (transformer_lm_decode,
                                          transformer_lm_prefill,
-                                         transformer_lm_prefix_prefill)
+                                         transformer_lm_prefix_prefill,
+                                         transformer_lm_verify)
         from .prefix_cache import EVICT_POLICIES, PrefixCache
         from .kv_cache import KV_DTYPES
+        from .speculative import PROPOSERS, make_proposer
 
         self._blocks_for = blocks_for_tokens
 
@@ -1029,6 +1033,33 @@ class DecodeEngine:
             raise MXNetError(
                 f"MXNET_SERVING_EVICT={self._evict_policy!r} must be "
                 f"one of {EVICT_POLICIES}")
+        # -- speculative decoding + chunked prefill ---------------------
+        self._spec_k = spec_tokens if spec_tokens is not None else \
+            _read_env_int("MXNET_SERVING_SPEC_TOKENS", lo=0)
+        self._spec_k = int(self._spec_k)
+        if self._spec_k < 0:
+            raise MXNetError(
+                f"spec_tokens {self._spec_k} must be >= 0")
+        if proposer is None or isinstance(proposer, str):
+            name = proposer if proposer is not None else \
+                _read_env_str("MXNET_SERVING_PROPOSER",
+                              choices=PROPOSERS)
+            self._proposer_name = name
+            self._proposer = make_proposer(name) if self._spec_k \
+                else None
+        else:  # a draft-LM / custom proposer instance slots in here
+            if not callable(getattr(proposer, "propose", None)):
+                raise MXNetError(
+                    f"proposer {proposer!r} must expose "
+                    f"propose(context, k) -> np.int32 tokens")
+            self._proposer_name = type(proposer).__name__
+            self._proposer = proposer
+        self._chunk = prefill_chunk if prefill_chunk is not None else \
+            _read_env_int("MXNET_SERVING_PREFILL_CHUNK", lo=0)
+        self._chunk = int(self._chunk)
+        if self._chunk < 0:
+            raise MXNetError(
+                f"prefill_chunk {self._chunk} must be >= 0")
         self._vocab = int(vocab_size)
         self._L = int(num_layers)
         self._H = int(num_heads)
@@ -1042,6 +1073,13 @@ class DecodeEngine:
         if int(self._kv_block) < 1:
             raise MXNetError(f"kv_block {self._kv_block} must be >= 1")
         self._kv_block = int(self._kv_block)
+        if self._chunk and self._chunk % self._kv_block:
+            raise MXNetError(
+                f"MXNET_SERVING_PREFILL_CHUNK={self._chunk} must be a "
+                f"multiple of kv_block {self._kv_block} — every chunk "
+                f"after the first must start block-aligned for the "
+                f"suffix-prefill continuation to be bit-identical to "
+                f"monolithic prefill")
         self._max_streams = max_streams if max_streams is not None else \
             _read_env_int("MXNET_SERVING_MAX_STREAMS")
         if int(self._max_streams) < 1:
@@ -1122,6 +1160,11 @@ class DecodeEngine:
                 f"cache_buckets {self._cache_buckets} does not cover "
                 f"the {self._max_blocks_seq} pages a max_len "
                 f"({self._max_len}) stream holds")
+        if self._chunk and self._chunk > self._prefill_buckets[-1]:
+            raise MXNetError(
+                f"prefill_chunk {self._chunk} exceeds the largest "
+                f"prefill bucket {self._prefill_buckets[-1]} — chunks "
+                f"are bucketed through the prefill ladder")
 
         # -- graphs + pools ---------------------------------------------
         kw = dict(vocab_size=vocab_size, num_layers=num_layers,
@@ -1133,11 +1176,15 @@ class DecodeEngine:
         self._dec_gfn = build_graph_fn(dec_sym)
         self._pre_gfn = build_graph_fn(pre_sym)
         self._pfx_gfn = None
-        if self._prefix_on:
-            pkw = dict(kw)
-            pkw.pop("paged")
+        pkw = dict(kw)
+        pkw.pop("paged")
+        if self._prefix_on or self._chunk:
+            # a chunk is a suffix-prefill continuation, so chunked
+            # prefill needs this graph even with the prefix cache off
             self._pfx_gfn = build_graph_fn(
                 transformer_lm_prefix_prefill(**pkw))
+        self._ver_gfn = build_graph_fn(transformer_lm_verify(**pkw)) \
+            if self._spec_k else None
         feed = {"data", "positions", "lengths", "block_table", "start"}
         feed |= {f"layer{i}_{t}pool" for i in range(self._L)
                  for t in "kv"}
@@ -1194,6 +1241,7 @@ class DecodeEngine:
         self._pending: List[_Stream] = []
         self._active: List[_Stream] = []
         self._admitting: Optional[_Stream] = None
+        self._prefilling: Optional[_Stream] = None  # mid-chunked-prefill
         self._accepting = True
         self._reject = None  # drain(): submit's refusal message
         self._alive = True
@@ -1247,10 +1295,12 @@ class DecodeEngine:
             raise MXNetError(
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
                 f"= {total} exceeds max_len {self._max_len}")
-        if prompt.size > self._prefill_buckets[-1]:
+        if prompt.size > self._prefill_buckets[-1] and not self._chunk:
             raise MXNetError(
                 f"prompt of {prompt.size} tokens exceeds the largest "
-                f"prefill bucket {self._prefill_buckets[-1]}")
+                f"prefill bucket {self._prefill_buckets[-1]} (enable "
+                f"MXNET_SERVING_PREFILL_CHUNK to prefill it in "
+                f"chunks)")
         need = self._blocks_for(total, self._kv_block)
         if need > self._alloc.capacity:
             raise MXNetError(
@@ -1356,10 +1406,13 @@ class DecodeEngine:
         for bb in self._decode_buckets:
             for mb in self._cache_buckets:
                 self._decode_exe(bb, mb)
-        if self._prefix is not None:
-            # suffix-prefill matrix: a table bucket narrower than the
-            # suffix itself can never occur (the table covers prefix +
-            # suffix pages), so those combinations are skipped
+                if self._spec_k:
+                    self._verify_exe(bb, mb)
+        if self._pfx_gfn is not None:
+            # suffix-prefill matrix (prefix-cache hits AND prefill
+            # chunks): a table bucket narrower than the suffix itself
+            # can never occur (the table covers prefix + suffix
+            # pages), so those combinations are skipped
             for tp in self._prefill_buckets:
                 for mb in self._cache_buckets:
                     if mb * self._kv_block >= tp:
@@ -1383,7 +1436,23 @@ class DecodeEngine:
         c = summ["counters"]
         out = {k: int(c.get(k, 0)) for k in
                ("requests", "generations", "tokens", "prefill_tokens",
-                "preempted", "prefills", "steps")}
+                "preempted", "prefills", "steps", "stream_steps",
+                "prefill_chunks", "spec_steps", "spec_proposed",
+                "spec_accepted", "spec_pages_rolled_back", "d2h_syncs",
+                "d2h_syncs_saved")}
+        # speculative-decoding headline ratios: how much of what the
+        # proposer offered the target model verified, and how many
+        # tokens ONE target-model evaluation of one stream commits
+        # (1.0 = no speculation; up to spec_tokens + 1)
+        out["accepted_token_rate"] = round(
+            out["spec_accepted"] / out["spec_proposed"], 4) \
+            if out["spec_proposed"] else 0.0
+        out["tokens_per_step"] = round(
+            out["tokens"] / out["stream_steps"], 4) \
+            if out["stream_steps"] else 0.0
+        out["spec_tokens"] = self._spec_k
+        out["proposer"] = self._proposer_name if self._spec_k else None
+        out["prefill_chunk"] = self._chunk
         tpt = summ["histograms"].get("time_per_token_ms")
         out["p50_ms"] = tpt["p50"] if tpt else None
         out["p90_ms"] = tpt["p90"] if tpt else None
@@ -1467,6 +1536,11 @@ class DecodeEngine:
                 if self._admitting not in streams:
                     streams.append(self._admitting)
                 self._admitting = None
+            # a stream mid-chunked-prefill is in neither list either
+            if self._prefilling is not None:
+                if self._prefilling not in streams:
+                    streams.append(self._prefilling)
+                self._prefilling = None
             self._pending, self._active = [], []
         for s in streams:
             if s.blocks:
@@ -1550,6 +1624,65 @@ class DecodeEngine:
                 jitted = jax.jit(
                     step,
                     donate_argnums=(8,) if self._donate else ())
+                exe = jitted.lower(*specs).compile()
+            self._exe_cache[key] = exe
+            self.compiles[key] = self.compiles.get(key, 0) + 1
+            return exe
+
+    def _verify_exe(self, bb: int, mb: int):
+        """Speculative verify step at batch bucket ``bb`` x table
+        bucket ``mb``: W = 1 + spec_tokens queries per stream, one
+        emission per query (the AOT bucket matrix's k dimension —
+        keyed separately from the plain decode step, which stays the
+        zero-draft fast path)."""
+        W = self._spec_k + 1
+        key = ("verify", bb, mb, W)
+        exe = self._exe_cache.get(key)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            exe = self._exe_cache.get(key)
+            if exe is not None:
+                return exe
+            import jax
+
+            from .speculative import verify_sample
+
+            gfn = self._ver_gfn
+            gkey = self._graph_key
+            base = self._base_key
+
+            def step(params, tokens, positions, start, lengths, table,
+                     temps, seeds, steps0, pools):
+                args = dict(params)
+                args.update(data=tokens, positions=positions,
+                            start=start, lengths=lengths,
+                            block_table=table)
+                self._pool_args(args, pools)
+                outs, _ = gfn(args, {}, gkey, False)
+                emit = verify_sample(base, outs[0], tokens,
+                                     lengths - start, temps, seeds,
+                                     steps0)
+                return emit, tuple(outs[1:])
+
+            i32 = np.dtype(np.int32)
+            specs = (self._spec_of(self._params),
+                     jax.ShapeDtypeStruct((bb, W), i32),
+                     jax.ShapeDtypeStruct((bb, W), i32),
+                     jax.ShapeDtypeStruct((bb,), i32),
+                     jax.ShapeDtypeStruct((bb,), i32),
+                     jax.ShapeDtypeStruct((bb, mb), i32),
+                     jax.ShapeDtypeStruct((bb,), np.dtype(np.float32)),
+                     jax.ShapeDtypeStruct((bb,), i32),
+                     jax.ShapeDtypeStruct((bb,), i32),
+                     self._spec_of(self._pools))
+            with profiler.scope(
+                    f"serving.compile.verify.b{bb}x{mb}w{W}",
+                    "serving", args={"batch": bb, "blocks": mb,
+                                     "window": W}):
+                jitted = jax.jit(
+                    step,
+                    donate_argnums=(9,) if self._donate else ())
                 exe = jitted.lower(*specs).compile()
             self._exe_cache[key] = exe
             self.compiles[key] = self.compiles.get(key, 0) + 1
@@ -1714,14 +1847,20 @@ class DecodeEngine:
             while True:
                 with self._cond:
                     while self._alive and not self._pending \
-                            and not self._active:
+                            and not self._active \
+                            and self._prefilling is None:
                         self._cond.wait(timeout=0.5)
                     if not self._alive:
                         return
                 self._admit()
+                if self._prefilling is not None:
+                    # ONE chunk per iteration: the decode step below
+                    # runs between chunks, so a long admission can no
+                    # longer stall every active stream's cadence
+                    self._prefill_chunk()
                 if self._active:
                     self._decode_step()
-                elif self._pending:
+                elif self._pending and self._prefilling is None:
                     # head-of-line request can't be admitted and no
                     # stream is decoding (transient: submit racing the
                     # loop) — don't busy-spin on the allocator
@@ -1769,7 +1908,8 @@ class DecodeEngine:
         while True:
             with self._lock:
                 if not self._pending \
-                        or len(self._active) >= self._max_streams:
+                        or len(self._active) >= self._max_streams \
+                        or self._prefilling is not None:
                     return
                 s = self._pending[0]
                 seq = s.prefill_seq()
@@ -1781,7 +1921,16 @@ class DecodeEngine:
                 # exactly the total minus the attached chain — the
                 # fully-cached prompt is the 0-token path:
                 # blocks_for_tokens(0) == 0 new pages
-                if cached:
+                chunked = bool(self._chunk) \
+                    and len(seq) - cached > self._chunk
+                if chunked:
+                    # admission charges pages incrementally per chunk:
+                    # the gate covers only the FIRST chunk (later
+                    # chunks allocate as they run; decode retirements
+                    # keep refilling the pool between them)
+                    need = self._blocks_for(self._chunk,
+                                            self._kv_block)
+                elif cached:
                     need = self._blocks_for(len(seq) - cached,
                                             self._kv_block)
                 else:
@@ -1805,6 +1954,14 @@ class DecodeEngine:
                 cached, pages = 0, []
             s.blocks = pages  # attach now: a dying prefill must not leak
             s.cached_len = cached
+            if chunked:
+                # hand off to the chunk state machine: s.length tracks
+                # tokens cached so far; chunks run at iteration
+                # boundaries, interleaved with decode steps
+                s.length = cached
+                self._prefilling = s
+                self._admitting = None
+                return
             new_pages = self._palloc(need, owner=s.sid)
             if new_pages is None:  # pragma: no cover - defensive
                 raise MXNetError(
@@ -1847,6 +2004,48 @@ class DecodeEngine:
         with self._lock:
             self._active.append(s)
 
+    def _suffix_prefill_call(self, s: _Stream, seq: np.ndarray,
+                             done: int, end: int, label: str,
+                             kind: str, extra: dict):
+        """Launch the suffix-prefill executable over
+        ``seq[done:end]`` (absolute token offsets, ``done``
+        block-aligned): the ONE feed builder behind both a
+        prefix-cache hit's one-shot suffix and every chunk of a
+        chunked prefill, so the two paths cannot drift apart (both
+        bit-identity contracts are pinned against the same monolithic
+        prefill).  Returns the sampled-token DEVICE array (meaningful
+        only when ``end`` covers the full sequence — the caller
+        decides whether to fetch it) and the prefill bucket used."""
+        from .io import stage_array
+
+        dev = self._device
+        n = len(seq)
+        csize = end - done
+        tp = self._bucket(self._prefill_buckets, csize, label)
+        mb = self._bucket(self._cache_buckets, len(s.blocks),
+                          "cache blocks")
+        exe = self._prefix_prefill_exe(tp, mb)
+        tokens = np.zeros((1, tp), np.int32)
+        tokens[0, :csize] = seq[done:end]
+        positions = (done + np.arange(tp, dtype=np.int32))[None]
+        start = np.asarray([done], np.int32)
+        lengths = np.asarray([end], np.int32)
+        table = np.zeros((1, mb), np.int32)
+        table[0, :len(s.blocks)] = s.blocks
+        temps = np.asarray([s.temp], np.float32)
+        seeds = np.asarray([s.seed], np.int32)
+        steps = np.asarray([n - 1], np.int32)  # sampling position
+        with profiler.scope(f"serving.prefill.{kind}.t{tp}",
+                            "serving",
+                            args=dict(extra, tokens=csize, bucket=tp)):
+            toks, self._pools = exe(
+                self._params, stage_array(tokens, dev),
+                stage_array(positions, dev), stage_array(start, dev),
+                stage_array(lengths, dev), stage_array(table, dev),
+                stage_array(temps, dev), stage_array(seeds, dev),
+                stage_array(steps, dev), self._pools)
+        return toks, tp
+
     def _prefill(self, s: _Stream, seq: np.ndarray, pages: List[int]):
         from .io import stage_array
 
@@ -1861,31 +2060,11 @@ class DecodeEngine:
             # prefix hit: prefill ONLY the uncached suffix, attending
             # the shared prefix through the block table
             ns = n - c
-            tp = self._bucket(self._prefill_buckets, ns,
-                              "suffix length")
-            mb = self._bucket(self._cache_buckets, len(pages),
-                              "cache blocks")
-            exe = self._prefix_prefill_exe(tp, mb)
-            tokens = np.zeros((1, tp), np.int32)
-            tokens[0, :ns] = seq[c:]
-            positions = (c + np.arange(tp, dtype=np.int32))[None]
-            start = np.asarray([c], np.int32)
-            lengths = np.asarray([n], np.int32)
-            table = np.zeros((1, mb), np.int32)
-            table[0, :len(pages)] = pages
-            with profiler.scope(f"serving.prefill.suffix.t{tp}",
-                                "serving",
-                                args={"tokens": ns, "cached": c,
-                                      "bucket": tp,
-                                      "resume": s.resume}):
-                toks, self._pools = exe(
-                    self._params, stage_array(tokens, dev),
-                    stage_array(positions, dev),
-                    stage_array(start, dev),
-                    stage_array(lengths, dev), stage_array(table, dev),
-                    stage_array(temps, dev), stage_array(seeds, dev),
-                    stage_array(steps, dev), self._pools)
-                first = int(np.asarray(toks)[0])
+            s.blocks = pages
+            toks, tp = self._suffix_prefill_call(
+                s, seq, c, n, "suffix length", "suffix",
+                {"cached": c, "resume": s.resume})
+            first = int(np.asarray(toks)[0])
         else:
             ns = n
             tp = self._bucket(self._prefill_buckets, n, "prompt length")
@@ -1910,11 +2089,19 @@ class DecodeEngine:
                 first = int(np.asarray(toks)[0])
         s.blocks = pages
         s.length = n
+        self._finish_prefill(s, first, n, ns, c, tp, t_pre0,
+                             time.perf_counter())
+
+    def _finish_prefill(self, s: _Stream, first: int, n: int, ns: int,
+                        c: int, tp: int, t_pre0: float, t_done: float):
+        """Shared completion tail of monolithic, suffix, and (final-
+        chunk) chunked prefill: register the prompt's pages, book the
+        timing/TTFT metrics, deliver the first token, activate or
+        retire."""
         if self._prefix is not None:
             # the prompt's full pages become shareable; blocks already
             # indexed keep the incumbent page (ours stays private)
             self._prefix.register(s.prompt, s.blocks)
-        t_done = time.perf_counter()
         prefill_ms = (t_done - t_pre0) * 1e3
         self._metrics.observe("prefill_ms", prefill_ms)
         profiler.observe("serving.prefill_ms", prefill_ms)
@@ -1922,7 +2109,8 @@ class DecodeEngine:
             # queue wait (enqueue → prefill start) and the prefill
             # itself, as child spans of the request's trace — a resume
             # prefill's queue span covers only the post-preemption
-            # wait, not the service time already rendered
+            # wait, not the service time already rendered; a chunked
+            # prefill's earlier chunks emitted their own spans
             profiler.add_trace_event(
                 "serving.queue", s.t_enqueue, t_pre0 - s.t_enqueue,
                 s.trace.child(), cat="serving",
@@ -1959,6 +2147,54 @@ class DecodeEngine:
             with self._lock:
                 self._active.append(s)
 
+    def _prefill_chunk(self):
+        """Advance the in-flight chunked prefill by ONE fixed-size
+        slice — a suffix-prefill continuation (the PR-13 executable
+        already takes an offset): the chunk's K/V is written at
+        absolute offset ``s.length`` and its queries attend the pages
+        already cached plus the chunk causally, bit-identical (lax
+        path, fp32 pools) to the matching rows of monolithic prefill.
+        A chunk that cannot get its pages simply waits for the next
+        iteration (decode retirements refill the pool); only the FINAL
+        chunk samples the first token and activates the stream."""
+        s = self._prefilling
+        seq = s.prefill_seq()
+        n = len(seq)
+        done = s.length       # tokens cached so far (block-aligned)
+        end = min(done + self._chunk, n)
+        need = self._blocks_for(end, self._kv_block) - len(s.blocks)
+        if need > 0:
+            pages = self._palloc(need, owner=s.sid)
+            if pages is None:
+                return  # pool dry: retry after the next decode step
+            s.blocks.extend(pages)
+        t0 = time.perf_counter()
+        if done == s.cached_len:
+            s.t_chunk0 = t0  # first chunk: queue wait ends here
+        toks, tp = self._suffix_prefill_call(
+            s, seq, done, end, "chunk length", "chunk",
+            {"sid": s.sid, "offset": done, "of": n})
+        # the sampled token only means anything on the final chunk —
+        # fetching it on every chunk would serialize the scheduler
+        # with each chunk's full device wall, the exact stall chunking
+        # exists to bound.  Non-final chunks stay async: the
+        # interleaved decode step queues behind them on the device (so
+        # chunk_ms here times the launch, not the compute, for those).
+        if end >= n:
+            first = int(np.asarray(toks)[0])
+            self._count("d2h_syncs")
+        t_done = time.perf_counter()
+        self._count("prefill_chunks")
+        self._metrics.observe("prefill_chunk_ms", (t_done - t0) * 1e3)
+        profiler.observe("serving.prefill_chunk_ms",
+                         (t_done - t0) * 1e3)
+        s.length = end
+        if end < n:
+            return  # more chunks to go; a decode step runs in between
+        self._prefilling = None
+        self._finish_prefill(s, first, n, n - s.cached_len,
+                             s.cached_len, tp, s.t_chunk0, t_done)
+
     def _reclaimable(self, v: _Stream) -> int:
         """Pages preempting ``v`` would actually return to the pool:
         the ones ``v`` holds exclusively (a shared page only loses one
@@ -1979,9 +2215,12 @@ class DecodeEngine:
                 return pages
             # a victim must be able to COME BACK: its resume
             # re-prefill (prompt + progress = its cached tokens) has
-            # to fit the prefill ladder
+            # to fit the prefill ladder — unless chunked prefill is
+            # on, which re-prefills ANY length in ladder-sized slices,
+            # making every stream preemptable
             victims = [v for v in self._active if v is not s
-                       and v.length <= self._prefill_buckets[-1]]
+                       and (self._chunk
+                            or v.length <= self._prefill_buckets[-1])]
             if not victims:
                 with self._lock:
                     self._active.remove(s)
@@ -2010,14 +2249,17 @@ class DecodeEngine:
                          key=lambda v: v.t_admit)
             self._preempt(victim)
 
-    def _ensure_capacity(self, s: _Stream) -> bool:
-        """Grow ``s`` by one token's page if needed; preempt the
-        youngest other stream when the pool is exhausted.  False when
-        ``s`` itself could not be kept resident."""
-        if self._blocks_for(s.length + 1, self._kv_block) \
-                <= len(s.blocks):
+    def _ensure_capacity(self, s: _Stream, ahead: int = 1) -> bool:
+        """Grow ``s`` to hold ``ahead`` more tokens' pages if needed
+        (1 = the classic next-token page; a verify window or the
+        pipelined double-step needs more); preempt the youngest other
+        stream when the pool is exhausted.  False when ``s`` itself
+        could not be kept resident."""
+        need = self._blocks_for(s.length + ahead, self._kv_block) \
+            - len(s.blocks)
+        if need <= 0:
             return True
-        pages = self._alloc_with_preempt(s, 1)
+        pages = self._alloc_with_preempt(s, need)
         if pages is None:
             return False
         s.blocks.extend(pages)
@@ -2081,7 +2323,168 @@ class DecodeEngine:
             s.future.set_result(np.asarray(s.generated, np.int32))
         self._count("generations")
 
+    def _propose(self, s: _Stream) -> np.ndarray:
+        """Draft tokens for one stream, capped by the step's usable
+        budget: emissions left before max_new, positions left before
+        max_len, and the engine's draft depth."""
+        room = min(s.max_new - len(s.generated) - 1,
+                   self._max_len - s.length - 1, self._spec_k)
+        if room < 1:
+            return np.empty(0, np.int32)
+        ctx = np.concatenate(
+            [s.prompt, np.asarray(s.generated, np.int32)]) \
+            if s.generated else s.prompt
+        d = np.asarray(self._proposer.propose(ctx, room), np.int32)
+        return d[:room]
+
     def _decode_step(self):
+        if self._spec_k:
+            with self._lock:
+                streams = list(self._active)
+            drafts = {s.sid: self._propose(s) for s in streams}
+            if any(d.size for d in drafts.values()):
+                return self._verify_step(drafts)
+            # nothing proposed anywhere: the plain one-token step IS
+            # the zero-draft verify step (bit-identically, greedy and
+            # temperature alike) at a fraction of the compute
+        self._plain_step()
+
+    def _verify_step(self, drafts: Dict[int, np.ndarray]):
+        """One speculative scheduling step: feed every active stream
+        its pending token plus its draft window, score all positions
+        in ONE multi-query program, commit the longest verified prefix
+        (plus the bonus emission at the first mismatch) and roll back
+        pages that held only rejected tokens."""
+        from .io import stage_array
+        from .kv_cache import trim_blocks
+
+        t0 = time.perf_counter()
+        for s in list(self._active):
+            if s in self._active:
+                w = 1 + len(drafts.get(s.sid, ()))
+                self._ensure_capacity(s, ahead=w)
+        if self._prefix is not None:
+            for s in list(self._active):
+                if s in self._active:
+                    self._maybe_cow(s)
+        with self._lock:
+            streams = list(self._active)
+        if not streams:
+            return
+        n = len(streams)
+        W = self._spec_k + 1
+        bb = self._bucket(self._decode_buckets, n, "active streams")
+        mb = self._bucket(self._cache_buckets,
+                          max(len(s.blocks) for s in streams),
+                          "cache blocks")
+        exe = self._verify_exe(bb, mb)
+        tokens = np.zeros((bb, W), np.int32)
+        positions = np.zeros((bb, W), np.int32)
+        start = np.zeros((bb,), np.int32)
+        lengths = np.zeros((bb,), np.int32)
+        table = np.zeros((bb, mb), np.int32)
+        temps = np.zeros((bb,), np.float32)
+        seeds = np.zeros((bb,), np.int32)
+        steps0 = np.zeros((bb,), np.int32)
+        fed: List[np.ndarray] = []
+        proposed = 0
+        for i, s in enumerate(streams):
+            d = drafts.get(s.sid)
+            if d is None:  # admitted after the propose pass
+                d = np.empty(0, np.int32)
+            w = 1 + len(d)
+            row = np.concatenate(
+                [np.asarray([s.next_token], np.int32), d])
+            fed.append(row)
+            proposed += len(d)
+            tokens[i, :w] = row
+            # pad rows keep in-range positions (their pos-embed rows
+            # are garbage anyway); their K/V writes route to the
+            # scratch page because lengths[i] stops at the live window
+            positions[i] = np.minimum(s.length + np.arange(W),
+                                      self._max_len - 1)
+            start[i] = s.length
+            lengths[i] = s.length + w
+            table[i, :len(s.blocks)] = s.blocks
+            temps[i] = s.temp
+            seeds[i] = s.seed
+            steps0[i] = s.length  # row j keys position length + j
+        dev = self._device
+        with profiler.scope(f"serving.verify_step.b{bb}x{mb}",
+                            "serving",
+                            args={"active": n, "batch": bb,
+                                  "blocks": mb, "window": W}):
+            emit, self._pools = exe(
+                self._params, stage_array(tokens, dev),
+                stage_array(positions, dev), stage_array(start, dev),
+                stage_array(lengths, dev), stage_array(table, dev),
+                stage_array(temps, dev), stage_array(seeds, dev),
+                stage_array(steps0, dev), self._pools)
+            emit = np.asarray(emit)  # ONE (B, W) D2H for k+1 tokens
+        self._count("d2h_syncs")
+        t_done = time.perf_counter()
+        step_ms = (t_done - t0) * 1e3
+        self._count("steps")
+        self._count("stream_steps", n)
+        self._count("spec_steps")
+        self._count("spec_proposed", proposed)
+        self._metrics.observe("step_ms", step_ms)
+        profiler.observe("serving.decode_step_ms", step_ms)
+        retired = []
+        for i, s in enumerate(streams):
+            d = fed[i][1:]
+            t = 0
+            for j in range(len(fed[i])):
+                tok = int(emit[i, j])
+                # every emission up to and including the first
+                # mismatch is an exact sample for its own slot
+                s.generated.append(tok)
+                t += 1
+                if len(s.generated) >= s.max_new or \
+                        (s.eos is not None and tok == s.eos):
+                    break
+                if j < len(d) and tok != int(d[j]):
+                    break
+            s.length += t
+            s.next_token = s.generated[-1]
+            self._count("tokens", t)
+            self._count("spec_accepted", t - 1)
+            if s.await_first:
+                s.await_first = False
+                ttft = (t_done - s.t_submit) * 1e3
+                self._metrics.observe("ttft_ms", ttft)
+                profiler.observe("serving.ttft_ms", ttft)
+                self._metrics.observe("ttft_hit_ms", ttft)
+                profiler.observe("serving.ttft_hit_ms", ttft)
+            per_tok = step_ms / t
+            for _ in range(t):
+                self._metrics.observe("time_per_token_ms", per_tok)
+                profiler.observe("serving.time_per_token_ms", per_tok)
+            # rejected-token rollback: pages past the committed tail
+            # (+ the pending token's slot) held only rejected writes
+            keep, surplus = trim_blocks(s.blocks, s.length + 1,
+                                        self._kv_block)
+            if surplus:
+                s.blocks = keep
+                self._release_pages(surplus)
+                self._count("spec_pages_rolled_back", len(surplus))
+            if s.trace is not None:
+                profiler.add_trace_event(
+                    "serving.verify_step", t0, t_done - t0,
+                    s.trace.child(), cat="serving",
+                    args={"sid": s.sid, "position": s.length,
+                          "batch": bb, "active": n,
+                          "drafts": int(len(d)), "accepted": t - 1})
+            if s.done():
+                retired.append(s)
+        if retired:
+            with self._lock:
+                for s in retired:
+                    self._active.remove(s)
+            for s in retired:
+                self._retire(s)
+
+    def _plain_step(self):
         from .io import stage_array
 
         t0 = time.perf_counter()
@@ -2096,6 +2499,34 @@ class DecodeEngine:
             streams = list(self._active)
         if not streams:
             return
+        # Double-buffered fetch: when the next step's batch is
+        # provably THIS one's (nothing pending, no chunked prefill in
+        # flight, no stream can retire, pages already cover two more
+        # tokens, the next write cannot COW), launch step t+1 straight
+        # from step t's still-on-device tokens and only then copy step
+        # t's (B,) result to the host — the copy overlaps step t+1's
+        # compute instead of gating the loop.  Sampling is keyed
+        # (seed, stream, position), so the pipelined pair emits the
+        # same bits the two sequential steps would.
+        pipeline = (not self._pending and self._prefilling is None
+                    and all(s.eos is None
+                            and len(s.generated) + 2 <= s.max_new
+                            for s in streams))
+        if pipeline:
+            for s in streams:
+                if s not in self._active \
+                        or not self._ensure_capacity(s, ahead=2):
+                    pipeline = False
+                    break
+            with self._lock:
+                cur = list(self._active)
+            if cur != streams:
+                # growing two-ahead preempted someone: re-snapshot and
+                # run this iteration unpipelined
+                streams = cur
+                pipeline = False
+                if not streams:
+                    return
         n = len(streams)
         bb = self._bucket(self._decode_buckets, n, "active streams")
         mb = self._bucket(self._cache_buckets,
@@ -2121,17 +2552,56 @@ class DecodeEngine:
         with profiler.scope(f"serving.decode_step.b{bb}x{mb}",
                             "serving",
                             args={"active": n, "batch": bb,
-                                  "blocks": mb}):
-            toks, self._pools = exe(
+                                  "blocks": mb,
+                                  "pipelined": pipeline}):
+            toks_dev, self._pools = exe(
                 self._params, stage_array(tokens, dev),
                 stage_array(positions, dev), stage_array(lengths, dev),
                 stage_array(table, dev), stage_array(temps, dev),
                 stage_array(seeds, dev), stage_array(steps, dev),
                 self._pools)
-            toks = np.asarray(toks)
+        if not pipeline:
+            toks = np.asarray(toks_dev)
+            self._count("d2h_syncs")
+            t_done = time.perf_counter()
+            self._absorb_step(streams, toks, t0, t_done, bb, n)
+            return
+        # step t+1, fed from the device: live rows advance one
+        # position; pad rows stay dead (lengths 0 keeps their write on
+        # the scratch page and their mask empty)
+        live = lengths > 0
+        positions2 = positions + live[:, None].astype(np.int32)
+        lengths2 = np.where(live, lengths + 1, 0).astype(np.int32)
+        steps2 = steps + 1
+        with profiler.scope(f"serving.decode_step.b{bb}x{mb}",
+                            "serving",
+                            args={"active": n, "batch": bb,
+                                  "blocks": mb, "pipelined": True}):
+            toks2_dev, self._pools = exe(
+                self._params, toks_dev.reshape(bb, 1),
+                stage_array(positions2, dev),
+                stage_array(lengths2, dev), stage_array(table, dev),
+                stage_array(temps, dev), stage_array(seeds, dev),
+                stage_array(steps2, dev), self._pools)
+        toks = np.asarray(toks_dev)  # overlaps step t+1's compute
+        self._count("d2h_syncs")
+        self._count("d2h_syncs_saved")
+        t_mid = time.perf_counter()
+        # no retires possible (predicate): t+1's assumed composition
+        # held, so its results are the real step t+1
+        self._absorb_step(streams, toks, t0, t_mid, bb, n)
+        toks2 = np.asarray(toks2_dev)
+        self._count("d2h_syncs")
         t_done = time.perf_counter()
+        self._absorb_step(streams, toks2, t_mid, t_done, bb, n)
+
+    def _absorb_step(self, streams, toks, t0, t_done, bb, n):
+        """Book one plain decode step's results into the scheduler:
+        counters, per-stream token append, full-hit TTFT, trace spans,
+        retirement."""
         step_ms = (t_done - t0) * 1e3
         self._count("steps")
+        self._count("stream_steps", n)
         self._count("tokens", n)
         self._metrics.observe("step_ms", step_ms)
         profiler.observe("serving.decode_step_ms", step_ms)

@@ -1,0 +1,189 @@
+"""Speculative decoding: draft proposers and the exact verify sampler.
+
+The decode loop's latency floor is the step cadence itself — one
+target-model step per token per stream (PERF.md decode appendix).
+Draft-and-verify speculation (Leviathan et al., "Fast Inference from
+Transformers via Speculative Decoding") amortizes that: a cheap
+**proposer** guesses ``k`` candidate tokens, the target model scores
+all of them in ONE batched multi-query step
+(``ops.attention.QKVPagedVerifyAttend``), and the longest verified
+prefix — plus one token the target emits for free at the first
+mismatch — commits per step.  Every emitted token is an exact sample
+from the target model, so the output distribution (and, under greedy,
+the output BITS) is identical to non-speculative decoding.
+
+Proposers here are **model-free self-drafters** — no second model to
+load, schedule or keep weight-synced:
+
+* :class:`NgramProposer` (prompt-lookup decoding, Saxena '23): match
+  the stream's trailing n-gram against its own history (prompt +
+  generated) and propose the continuation of the MOST RECENT earlier
+  occurrence.  Repetitive text — code, templated chat, quoting — hits
+  constantly; random text proposes nothing (and the engine falls back
+  to the plain one-token step, paying no verify overhead).
+
+The interface is deliberately small so a small draft LM can slot in
+later: ``propose(context, k) -> np.int32[:k]`` on the host, called
+once per stream per scheduling step.  Proposals must be DETERMINISTIC
+functions of the context — the fleet's decode-retry bit-exactness
+(PR 9) replays a dead replica's stream from the same prompt/seed and
+must re-propose, re-verify and re-emit the same tokens.
+
+The **verify sampler** (:func:`verify_sample`) is the distribution-
+preserving half.  For a deterministic proposer the draft distribution
+at each slot is a point mass at the draft token ``d``, so Leviathan
+rejection sampling reduces to: accept ``d`` with probability
+``p_target(d)``; on rejection, sample from the residual — the target
+distribution with ``d`` removed and renormalized.  The marginal of
+the emitted token is exactly ``p_target`` (``P(x=d) = p(d)``;
+``P(x=y≠d) = (1-p(d)) * p(y)/(1-p(d)) = p(y)``).  Greedy (temp 0)
+emits argmax rows, so acceptance is exact prefix match.  All
+randomness is keyed by the engine's existing (seed, stream, position)
+scheme, which keeps sampling independent of batch composition and of
+HOW MANY tokens each step verified.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["NgramProposer", "make_proposer", "verify_sample",
+           "PROPOSERS"]
+
+PROPOSERS = ("ngram",)
+
+
+class NgramProposer:
+    """Prompt-lookup self-drafting: propose the continuation of the
+    most recent earlier occurrence of the stream's trailing n-gram.
+
+    Tries the longest n-gram first (``max_ngram`` down to
+    ``min_ngram``); the first (longest) match wins, and within one
+    n-gram length the MOST RECENT occurrence wins — both choices are
+    deterministic functions of the context, never of wall time or
+    iteration order."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if not (1 <= min_ngram <= max_ngram):
+            raise MXNetError(
+                f"NgramProposer wants 1 <= min_ngram <= max_ngram; got "
+                f"min={min_ngram} max={max_ngram}")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, context: np.ndarray, k: int) -> np.ndarray:
+        """Up to ``k`` draft tokens continuing ``context`` (1-D int32,
+        prompt + everything generated so far, pending token included).
+        Empty when no trailing n-gram recurs earlier in the context.
+
+        The scan is vectorized (one sliding-window comparison per
+        n-gram length): the proposer runs on the scheduler thread once
+        per stream per step, so a Python-loop match would cost more
+        than the verify step it feeds."""
+        ctx = np.asarray(context, np.int32)
+        n = ctx.size
+        if k < 1 or n < self.min_ngram + 1:
+            return np.empty(0, np.int32)
+        for g in range(min(self.max_ngram, n - 1), self.min_ngram - 1,
+                       -1):
+            tail = ctx[n - g:]
+            # windows[i] = ctx[i:i+g] for i in [0, n-g-1]: every
+            # earlier g-gram (the final window — the tail itself — is
+            # excluded so the match has a continuation to copy)
+            windows = np.lib.stride_tricks.sliding_window_view(
+                ctx[:-1], g)
+            hits = np.flatnonzero((windows == tail).all(axis=1))
+            if hits.size:
+                end = int(hits[-1]) + g  # most recent occurrence
+                take = min(k, n - end)
+                if take > 0:
+                    return ctx[end:end + take].copy()
+        return np.empty(0, np.int32)
+
+
+def make_proposer(name: str, **kw):
+    """Proposer registry (``MXNET_SERVING_PROPOSER``): unknown names
+    raise loudly at engine construction."""
+    if name == "ngram":
+        return NgramProposer(**kw)
+    raise MXNetError(
+        f"unknown speculative proposer {name!r} "
+        f"(MXNET_SERVING_PROPOSER wants one of {PROPOSERS})")
+
+
+def verify_sample(base_key, logits, fed, wlive, temps, seeds, steps0):
+    """On-device verify-step sampling: one emission per query row.
+
+    ``logits`` (B, W, V): the target model's rows for the verify
+    window — row ``j`` of stream ``b`` sits at absolute position
+    ``steps0[b] + j`` and predicts the token for the NEXT slot.
+    ``fed`` (B, W) int32: the tokens actually fed this step
+    (``[pending, draft_1, .., draft_{W-1}]``; pad rows may hold
+    anything).  ``wlive`` (B,) int32: LIVE rows per stream (1 +
+    drafts) — the draft under verification at row ``j`` is
+    ``fed[b, j+1]`` only while ``j + 1 < wlive[b]``; the stream's
+    last live row and everything past it verify nothing (a padded
+    ``fed`` column must NOT be mistaken for a draft of token 0, or a
+    short-window stream's bonus emission would take the rejection
+    path and its bits would depend on how wide the batch's window
+    happened to be).  ``temps``/``seeds`` (B,) float32/int32,
+    ``steps0`` (B,) int32 — the same per-stream sampling identity the
+    plain decode step uses, with row ``j`` keyed by position
+    ``steps0[b] + j`` so a token's randomness does not depend on
+    which step (or how wide a window) sampled it.
+
+    Per row: greedy (temp <= 0) emits argmax.  Temperature rows with a
+    draft run exact rejection sampling — accept the draft with
+    probability ``p_target(draft)`` (uniform from ``fold_in(key, 1)``),
+    else resample from the residual (draft masked out,
+    ``fold_in(key, 2)``); rows with no draft sample
+    ``categorical(key)`` exactly like the non-speculative sampler, so
+    a zero-draft verify step is BIT-identical to a plain decode step
+    under temperature too.  Returns (B, W) int32 emissions; the caller
+    keeps the longest prefix in which each emission matches the next
+    fed token (plus the first mismatching emission, which is a valid
+    sample for its own slot)."""
+    import jax
+    import jax.numpy as jnp
+
+    V = logits.shape[-1]
+    W = fed.shape[1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # draft at row j = token fed at row j+1, but ONLY while row j+1 is
+    # a live draft row; the bonus row and pad rows get the -1 no-draft
+    # sentinel (they sample categorical(key), the plain-sampler path)
+    drafts = jnp.concatenate(
+        [fed[:, 1:], -jnp.ones((fed.shape[0], 1), jnp.int32)], axis=1)
+    drafts = jnp.where(jnp.arange(W)[None, :] + 1 < wlive[:, None],
+                       drafts, -1)
+
+    def one_row(key, row, tp, d):
+        safe = jnp.where(tp > 0, tp, 1.0)
+        scaled = row / safe
+        direct = jax.random.categorical(key, scaled).astype(jnp.int32)
+        p = jax.nn.softmax(scaled)
+        d_ix = jnp.clip(d, 0, V - 1)
+        u = jax.random.uniform(jax.random.fold_in(key, 1))
+        accept = u < p[d_ix]
+        residual = jnp.where(jnp.arange(V) == d_ix, -jnp.inf, scaled)
+        resampled = jax.random.categorical(
+            jax.random.fold_in(key, 2), residual).astype(jnp.int32)
+        sampled = jnp.where(d < 0, direct,
+                            jnp.where(accept, d_ix, resampled))
+        return sampled
+
+    def one_stream(sd, st0, rows, tp, ds):
+        skey = jax.random.fold_in(base_key, sd)
+
+        def at(j, row, d):
+            return one_row(jax.random.fold_in(skey, st0 + j), row, tp, d)
+
+        W = rows.shape[0]
+        return jax.vmap(at)(jnp.arange(W), rows, ds)
+
+    sampled = jax.vmap(one_stream)(seeds, steps0, logits, temps, drafts)
+    return jnp.where(temps[:, None] > 0, sampled, greedy)
