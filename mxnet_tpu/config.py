@@ -16,7 +16,8 @@ from typing import Any, Dict, List
 
 from .base import get_env
 
-__all__ = ["EnvVar", "register_env", "list_env", "describe", "current"]
+__all__ = ["EnvVar", "register_env", "list_env", "describe", "current",
+           "env_bool", "ensure_overlap_flags"]
 
 EnvVar = namedtuple("EnvVar", ["name", "default", "dtype", "doc"])
 
@@ -100,6 +101,50 @@ register_env(
     "activations, ZeRO optimizer state ('zero' axis) — resolves "
     "through this ONE table.  A named axis no rule matches raises "
     "loudly.  Malformed entries raise at plan construction.")
+register_env(
+    "MXNET_ZERO_BUCKET_BYTES", 4 << 20, int,
+    "Capacity in BYTES of one in-program gradient-collective bucket "
+    "(default 4 MiB): the ZeRO-1 update segment packs same-dtype "
+    "flat gradients into buckets EMITTED IN BACKWARD ORDER, one "
+    "reduce-scatter + one updated-param all-gather per bucket, so the "
+    "async-collective scheduler can run layer i's gradient collective "
+    "under layer i-1's backward compute (see README 'Training "
+    "raw-speed').  The pack layout is deterministic and per-lane "
+    "(pack -> sum -> unpack == per-key sums bitwise, the PR-3 comm.py "
+    "contract), so bucket size never changes numerics.  0: ONE "
+    "monolithic bucket holding every gradient (the serialized "
+    "baseline the overlap tests compare against).  A single gradient "
+    "larger than the bound rides its own bucket.  Negative or garbage "
+    "values raise when the fused step is built.")
+register_env(
+    "MXNET_PP_RESIDENT", 1, int,
+    "1 (default): under pipeline parallelism (pp > 1) the stacked "
+    "block parameters are stored STAGE-RESIDENT — per-slot (S, L/S, "
+    "...) slabs sharded P('pp', ...) so each pipeline stage holds "
+    "only its own layers' weights and optimizer state (~1/pp the "
+    "bytes; tools/bench_pp.py prints the number).  Stage-boundary "
+    "data movement runs through explicit shard_map ppermute/psum "
+    "helpers, NOT the SPMD partitioner's handling of a 'pp'-sharded "
+    "scan carry — the documented MXNET_PP_CONSTRAIN miscompile on "
+    "this jaxlib never gets a chance to fire (equivalence-tested "
+    "against the replicated path, tests/test_pp.py).  0: the "
+    "replicated-weight path (stacked block weights rest replicated "
+    "over pp; the pre-residency behavior).  Values other than 0/1 "
+    "raise when the fused step is built.")
+register_env(
+    "MXNET_ASYNC_COLLECTIVES", 1, int,
+    "1 (default): on TPU/GPU backends, append the async-collective + "
+    "latency-hiding-scheduler XLA flags to XLA_FLAGS at import (TPU: "
+    "xla_enable_async_all_gather / xla_enable_async_collective_"
+    "permute / xla_tpu_enable_async_collective_fusion*; GPU: "
+    "xla_gpu_enable_latency_hiding_scheduler) so the per-bucket "
+    "gradient collectives emitted by the ZeRO update segment overlap "
+    "backward/update compute — the in-program analogue of the PR-3 "
+    "CommScheduler.  Flags the user already set in XLA_FLAGS are "
+    "never overridden.  On CPU builds nothing is appended (the TPU "
+    "flag names are unknown there and XLA aborts on unknown flags).  "
+    "0: leave XLA_FLAGS untouched.  Values other than 0/1 raise at "
+    "import.")
 register_env(
     "MXNET_PP_CONSTRAIN", 0, int,
     "1: pin the pipeline's (stage, microbatch, ...) activation stash "
@@ -543,3 +588,104 @@ register_env(
     "MXNET_TEST_TPU", 0, int,
     "1: run the pytest suite against the real TPU instead of the "
     "virtual CPU mesh (tests/conftest.py).")
+
+
+# ---------------------------------------------------------------------------
+# Async-collective XLA flag wiring (MXNET_ASYNC_COLLECTIVES)
+# ---------------------------------------------------------------------------
+
+# The flag sets the overlap path needs, per accelerator backend.  They
+# split each collective into <op>-start / <op>-done pairs and let the
+# latency-hiding scheduler move real compute between them — the
+# structural property tests/test_overlap.py inspects in the compiled
+# HLO.  GPU flag names are registered in every XLA build; the TPU ones
+# live in libtpu and are fatal-unknown elsewhere, hence the platform
+# gate in ensure_overlap_flags.
+TPU_OVERLAP_FLAGS = (
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+GPU_OVERLAP_FLAGS = (
+    "--xla_gpu_enable_latency_hiding_scheduler=true",
+)
+
+
+def env_bool(name: str) -> bool:
+    """Strict 0/1 read of a registered boolean env var: unset falls to
+    the catalog default, anything but '0'/'1' raises loudly (the
+    MXNET_CKPT_* validation pattern).  The one parser behind
+    MXNET_PP_RESIDENT and MXNET_ASYNC_COLLECTIVES."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return bool(_CATALOG[name].default)
+    if raw in ("0", "1"):
+        return raw == "1"
+    from .base import MXNetError
+
+    raise MXNetError(f"{name}={raw!r} must be 0 or 1")
+
+
+def _wants_tpu() -> bool:
+    """True when this process will initialize a TPU backend — decided
+    WITHOUT importing jax (XLA_FLAGS must be final before the first
+    backend query, and an unknown --xla_tpu_* flag aborts non-TPU
+    builds)."""
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if plats:
+        return "tpu" in plats.lower()
+    import importlib.util
+
+    try:
+        return importlib.util.find_spec("libtpu") is not None
+    except (ImportError, ValueError):
+        return False
+
+
+def _wants_gpu() -> bool:
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if plats:
+        return any(p in plats.lower() for p in ("gpu", "cuda", "rocm"))
+    # JAX_PLATFORMS unset is the COMMON GPU configuration (jax[cuda]
+    # autodetects): look for the PJRT plugin packages instead
+    import importlib.util
+
+    for name in ("jax_cuda12_plugin", "jax_cuda11_plugin",
+                 "jax_rocm60_plugin", "jax_rocm7_plugin"):
+        try:
+            if importlib.util.find_spec(name) is not None:
+                return True
+        except (ImportError, ValueError):
+            continue
+    return False
+
+
+def ensure_overlap_flags() -> bool:
+    """Append the async-collective / latency-hiding XLA flags to
+    ``XLA_FLAGS`` when MXNET_ASYNC_COLLECTIVES=1 and the process
+    targets an accelerator backend.  Called at package import (before
+    any jax backend exists); idempotent; never overrides a flag the
+    user already set (first occurrence wins in XLA's parser is NOT
+    guaranteed, so ours are simply skipped).  Returns True when flags
+    were appended."""
+    if not env_bool("MXNET_ASYNC_COLLECTIVES"):
+        return False
+    flags = ()
+    if _wants_tpu():
+        flags = TPU_OVERLAP_FLAGS + GPU_OVERLAP_FLAGS
+    elif _wants_gpu():
+        flags = GPU_OVERLAP_FLAGS
+    if not flags:
+        return False
+    current = os.environ.get("XLA_FLAGS", "")
+    have = {f.split("=")[0] for f in current.split() if f.startswith("--")}
+    add = [f for f in flags if f.split("=")[0] not in have]
+    if add:
+        os.environ["XLA_FLAGS"] = (current + " " + " ".join(add)).strip()
+    return bool(add)
+
+
+ensure_overlap_flags()

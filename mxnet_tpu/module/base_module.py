@@ -283,6 +283,21 @@ class BaseModule:
                                             train_iter=train_data)
                         ckpt_s = time.perf_counter() - t_ck0
                     goodput.step(step_s, io_s=io_s, ckpt_s=ckpt_s)
+                    # once per BUILT program, attribute the fused
+                    # program's OWN collectives to the comm fraction
+                    # (in-program reduce-scatter/all-gather otherwise
+                    # books as compute).  Costs one extra cached XLA
+                    # compile per program, so it waits for step 8 —
+                    # short smoke fits never pay — unless the ops
+                    # endpoint is live (an operator is watching; pay at
+                    # step 1).  Called every step past the threshold:
+                    # the module's per-program guard makes repeats free
+                    # and re-accounts after a mid-fit rebuild/re-mesh
+                    if (self._fit_step_count >= 8
+                            or (self._fit_step_count == 1
+                                and _prof.metrics_server_running())) \
+                            and hasattr(self, "account_program_comm"):
+                        self.account_program_comm()
                     if checkpoint is not None:
                         admitted = self._elastic_admit(
                             kv_obj, checkpoint, elastic_data, elastic)

@@ -190,6 +190,14 @@ class Module(BaseModule):
         # mesh data/tensor parallelism (mxnet_tpu.parallel): activated by
         # a multi-context list at bind or kvstore='tpu' at init_optimizer
         self._mesh_plan = None
+        # stage-resident pipeline weights (MXNET_PP_RESIDENT): when
+        # active, block params live as per-slot (S, L/S, ...) slabs
+        # sharded P('pp', ...) and the per-name executor arrays are
+        # freed until _materialize_pp_params hands authority back
+        self._pp_resident = False
+        self._pp_graph = None
+        self._pp_slabs = None
+        self._pp_slab_zero_meta = None
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -258,6 +266,7 @@ class Module(BaseModule):
         """reference: module.py get_params"""
         assert self.binded and self.params_initialized
         self._drain_param_comm()
+        self._materialize_pp_params()
         arg_params = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
         aux_params = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
         return arg_params, aux_params
@@ -272,6 +281,9 @@ class Module(BaseModule):
         # these same executor arrays, and draining after this write
         # would overwrite the freshly loaded values with stale weights
         self._drain_param_comm()
+        # writes go through arg_dict: stage-resident slabs must hand
+        # authority back first (and rebuild from these values later)
+        self._materialize_pp_params()
 
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
@@ -642,12 +654,21 @@ class Module(BaseModule):
             # elastic re-mesh is dp-only today: the rollback path
             # re-scatters flat 'dp'-sharded ZeRO slices, and silently
             # re-scattering state entangled with a pipeline ('pp') axis
-            # would corrupt it.  Fail loudly instead of corrupting.
+            # (including stage-resident weight slabs) would corrupt it.
+            # Fail loudly instead of corrupting.
             raise NotImplementedError(
                 f"Module.remesh on a pipeline-parallel plan (pp="
-                f"{max(old_pp, new_pp)}) is not implemented: elastic "
-                "re-mesh is dp-only today; restore a committed "
-                "checkpoint into a freshly-bound pp module instead")
+                f"{max(old_pp, new_pp)}) is not implemented: the "
+                "elastic re-mesh contract is dp-only (membership "
+                "changes re-scatter flat 'dp'-sharded ZeRO slices; a "
+                "'pp' axis — and MXNET_PP_RESIDENT weight slabs — "
+                "don't re-scatter that way).  Use the layout-"
+                "independent checkpoint reshard path instead: "
+                "save_checkpoint/CheckpointManager on the old plan, "
+                "bind a fresh Module under the new MeshPlan, and "
+                "restore — optimizer state and params re-scatter into "
+                "ANY dp/tp/pp layout on load (see README '3D "
+                "parallelism: checkpoints').")
         opt_payload = None
         if self.optimizer_initialized:
             opt_payload = self._optimizer_states_to_host(lazy=False)
@@ -666,6 +687,10 @@ class Module(BaseModule):
         self._lr_cache = {}
         self._zero = False
         self._zero_meta = None
+        self._zero_buckets = None
+        self._pp_resident = False
+        self._pp_graph = None
+        self._pp_slabs = None
         self._apply_mesh_plan()
         self.set_params(args, auxs)
         if opt_payload is not None:
@@ -754,6 +779,13 @@ class Module(BaseModule):
         self._step_count = other._step_count
         if other._fused_step is None:
             return  # nothing device-resident was built yet
+        if getattr(other, "_pp_resident", False):
+            raise MXNetError(
+                "BucketingModule state adoption from a stage-resident "
+                "pipeline module is not supported: the donated "
+                "optimizer state is keyed by parameter slabs that "
+                "don't transfer across symbols.  Set "
+                "MXNET_PP_RESIDENT=0 for bucketed pp training.")
         if self._fused_step is None:
             # build only the jitted programs; the state slots come from
             # the donor (allocating fresh ones here would be dead work).
@@ -765,6 +797,7 @@ class Module(BaseModule):
                 if self._exec.grad_req.get(n, "null") != "null"]
             self._zero = other._zero
             self._zero_meta = other._zero_meta
+            self._zero_buckets = getattr(other, "_zero_buckets", None)
             self._fused_step = self._build_fused_step()
             self._apply_grads = self._build_apply_grads()
         self._fused_state = other._fused_state
@@ -813,6 +846,7 @@ class Module(BaseModule):
             # defer: the fused program runs in update() with this batch
             self._pending_batch = kwargs
             return
+        self._materialize_pp_params()  # plain path reads arg_dict
         self._exec.forward(is_train=is_train, **kwargs)
 
     def backward(self, out_grads=None):
@@ -835,6 +869,7 @@ class Module(BaseModule):
             self._pending_batch = None
             if self._input_prologue is not None:
                 kwargs = self._apply_prologue_host(kwargs, True)
+            self._materialize_pp_params()
             self._exec.forward(is_train=True, **kwargs)
 
     def update(self):
@@ -939,23 +974,14 @@ class Module(BaseModule):
 
         return jax.jit(step, donate_argnums=(0, 3, 7))
 
-    def _build_pipelined_step(self):
-        """The pp>1 fused step: ONE donated XLA program whose
-        forward+backward segment is the mxnet_tpu.pp interleaved-1F1B
-        microbatch pipeline (vmapped stages over the 'pp' mesh axis,
-        collective-permute activation transfers, per-stage
-        recompute-backward), whose gradients arrive already ACCUMULATED
-        across microbatches, and whose optimizer segment is the very
-        same ``_make_param_update`` (ZeRO-1 over 'dp') the non-pipelined
-        step uses — 3D parallelism composed, not wired per model."""
-        import jax
-        import jax.numpy as jnp
-
-        from .. import config as _config
+    def _split_pp_graph(self):
+        """Validate + split the symbol for the pipeline executor
+        (cached — residency planning and step building both need it)."""
         from .. import pp as _pp
-        from ..base import get_env
 
         plan = self._mesh_plan
+        if getattr(self, "_pp_graph", None) is not None:
+            return self._pp_graph
         if self._aux_names:
             raise MXNetError(
                 "pipeline parallelism (pp > 1 / microbatches > 1) does "
@@ -982,20 +1008,270 @@ class Module(BaseModule):
                 f"pipeline block(s) consume graph input(s) {direct} "
                 "directly; keep an un-annotated pre region (embedding/"
                 "projection) in front of the first __pp_block__")
-        # per-param resolved specs so stacked per-stage views keep
-        # their rules-table tensor shardings
+        self._pp_graph = pg
+        return pg
+
+    def _pp_param_specs(self):
+        """Per-param resolved PartitionSpec tuples so stacked per-stage
+        views keep their rules-table tensor shardings."""
         param_specs = {}
         for n in self._param_names:
             sh = getattr(self._exec.arg_dict[n]._data, "sharding", None)
             spec = getattr(sh, "spec", None)
             param_specs[n] = tuple(spec) if spec is not None else ()
+        return param_specs
+
+    def _plan_pp_residency(self):
+        """Decide whether this pipelined module stores its block
+        parameters STAGE-RESIDENT (MXNET_PP_RESIDENT): per-slot slabs
+        stacked (S, L/S, ...) and sharded P('pp', ...), so each
+        stage's devices hold only their own layers' weights and
+        optimizer state (~1/pp the bytes — the placement the
+        partitioner bug forfeited; see mxnet_tpu/pp.py
+        build_resident_pipeline_fn for the shard_map workaround).
+
+        Residency needs a uniform slot: every layer of a slot
+        trainable with identical lr/wd multipliers (the slab updates
+        as ONE array).  A non-uniform model falls back to the
+        replicated path with a logged reason rather than failing."""
+        from .. import config as _config
+
+        self._pp_resident = False
+        plan = self._mesh_plan
+        if plan is None or plan.pp <= 1:
+            return
+        if not (self._use_fused and self.optimizer_initialized):
+            return
+        if not _config.env_bool("MXNET_PP_RESIDENT"):
+            return
+        pg = self._split_pp_graph()
+        opt = self._optimizer
+        slot_names = [[pg.block_params[l][s] for l in range(pg.num_layers)]
+                      for s in range(pg.num_slots)]
+        for names in slot_names:
+            reqs = {self._exec.grad_req.get(n, "null") for n in names}
+            if reqs != {"write"}:
+                self.logger.warning(
+                    "MXNET_PP_RESIDENT: slot %s mixes grad_req %s; "
+                    "falling back to replicated block weights",
+                    names[0], sorted(reqs))
+                return
+            mults = {(opt.lr_mult.get(n, 1.0), opt.wd_mult.get(n, 1.0))
+                     for n in names}
+            if len(mults) != 1:
+                self.logger.warning(
+                    "MXNET_PP_RESIDENT: slot %s has per-layer lr/wd "
+                    "multipliers; the slab updates as one array — "
+                    "falling back to replicated block weights",
+                    names[0])
+                return
+        param_specs = self._pp_param_specs()
+        self._pp_slot_names = slot_names
+        self._pp_slab_keys = [f"__ppslab{s}__"
+                              for s in range(pg.num_slots)]
+        self._pp_slab_sh = [
+            plan.pp_param_sharding(param_specs.get(names[0], ()))
+            for names in slot_names]
+        slab_members = {n for names in slot_names for n in names}
+        self._pp_slab_members = slab_members
+        self._pp_nonslab_grad_names = [
+            n for n in self._grad_param_names if n not in slab_members]
+        self._pp_slab_mults = {
+            key: (opt.lr_mult.get(names[0], 1.0),
+                  opt.wd_mult.get(names[0], 1.0))
+            for key, names in zip(self._pp_slab_keys, slot_names)}
+        self._pp_slabs = None  # built lazily (and after materialize)
+        self._pp_resident = True
+
+    @property
+    def _fused_param_keys(self):
+        """Keys of the fused step's donated ``params`` dict: per-name
+        trainable params, with block params replaced by their slab
+        keys under stage residency."""
+        if getattr(self, "_pp_resident", False):
+            return self._pp_nonslab_grad_names + self._pp_slab_keys
+        return self._grad_param_names
+
+    def _ensure_pp_slabs(self):
+        """Switch parameter authority to the stage-resident slabs:
+        stack each slot's per-name values into one (S, L/S, ...) slab
+        placed at P('pp', ...), then FREE the replicated per-name
+        device buffers (their bytes are the whole point).  The
+        per-name NDArrays keep answering shape/dtype (jax retains the
+        aval of a deleted array) but any data read must go through
+        :meth:`_materialize_pp_params` first — get_params, the plain
+        executor paths and the checkpoint snapshot all do.
+
+        The stack happens HOST-side on purpose: stacking on device and
+        constraining the concatenate to P('pp', ...) is the exact
+        pattern the MXNET_PP_CONSTRAIN partitioner bug miscompiles."""
+        if not getattr(self, "_pp_resident", False) \
+                or self._pp_slabs is not None:
+            return
+        from ..ndarray import gather_global
+
+        plan = self._mesh_plan
+        S = plan.pp
+        slabs = []
+        for names, sh in zip(self._pp_slot_names, self._pp_slab_sh):
+            host = np.stack([
+                np.asarray(gather_global(self._exec.arg_dict[n]._data))
+                for n in names])
+            host = host.reshape((S, len(names) // S) + host.shape[1:])
+            slabs.append(plan.place(host, sh))
+        for names in self._pp_slot_names:
+            for n in names:
+                for d in (self._exec.arg_dict.get(n),
+                          self._exec.grad_dict.get(n)):
+                    if d is not None and not d._data.is_deleted():
+                        d._data.delete()
+        self._pp_slabs = slabs
+        _prof.inc_counter("pp.slab_builds")
+
+    def _materialize_pp_params(self):
+        """Switch parameter authority back to the per-name executor
+        arrays: gather each slab to host, split per layer, re-place
+        every block param (and its zeroed grad buffer) at its bound
+        sharding, and DROP the slabs — the next fused step rebuilds
+        them.  No-op when slabs aren't active, so every consumer of
+        arg_dict (get_params, eval/monitored forward, checkpoint
+        snapshot) can call it unconditionally."""
+        slabs = getattr(self, "_pp_slabs", None)
+        if not slabs:
+            return
+        from ..ndarray import gather_global
+
+        plan = self._mesh_plan
+        for slab, names in zip(slabs, self._pp_slot_names):
+            host = np.asarray(gather_global(slab))
+            host = host.reshape((len(names),) + host.shape[2:])
+            for l, n in enumerate(names):
+                arr = self._exec.arg_dict[n]
+                arr._data = plan.place(host[l], arr._sharding)
+                g = self._exec.grad_dict.get(n)
+                if g is not None and g._data.is_deleted():
+                    g._data = plan.place(
+                        np.zeros(tuple(g.shape), g.dtype), g._sharding)
+        self._pp_slabs = None
+        _prof.inc_counter("pp.slab_materializes")
+
+    def _collect_fused_params(self):
+        """The fused step's donated ``params`` dict — per-name arrays,
+        or (under stage residency) per-name non-block arrays plus the
+        slab per slot."""
+        if getattr(self, "_pp_resident", False):
+            self._ensure_pp_slabs()
+            params = {n: self._exec.arg_dict[n]._data
+                      for n in self._pp_nonslab_grad_names}
+            params.update(dict(zip(self._pp_slab_keys, self._pp_slabs)))
+            return params
+        return {n: self._exec.arg_dict[n]._data
+                for n in self._grad_param_names}
+
+    def _store_fused_params(self, new_params):
+        """Write a fused step's returned params back to their storage:
+        slabs stay slabs (arg_dict's block entries remain freed), the
+        rest land in the executor arrays."""
+        if getattr(self, "_pp_resident", False):
+            idx = {k: i for i, k in enumerate(self._pp_slab_keys)}
+            for n, v in new_params.items():
+                if n in idx:
+                    self._pp_slabs[idx[n]] = v
+                else:
+                    self._exec.arg_dict[n]._set_data(v)
+            return
+        for n, v in new_params.items():
+            self._exec.arg_dict[n]._set_data(v)
+
+    def param_bytes_per_device(self):
+        """Bytes of LIVE parameter storage resident on ONE device —
+        slabs count their per-device shard, per-name arrays count
+        theirs, freed (slab-covered) buffers count zero.  bench_pp's
+        ``weight_bytes_per_device`` reads this; stage residency drops
+        it ~1/pp for the stacked block weights."""
+        total = 0
+
+        def add(d):
+            nonlocal total
+            if d is None or getattr(d, "is_deleted", lambda: False)():
+                return
+            sh = getattr(d, "sharding", None)
+            if sh is not None and hasattr(sh, "shard_shape"):
+                shard = sh.shard_shape(tuple(d.shape))
+                total += int(np.prod(shard, dtype=np.int64)
+                             * d.dtype.itemsize)
+            else:
+                total += int(d.nbytes)
+
+        for n in self._param_names:
+            add(self._exec.arg_dict[n]._data)
+        for slab in (getattr(self, "_pp_slabs", None) or []):
+            add(slab)
+        return total
+
+    def _build_pipelined_step(self):
+        """The pp>1 fused step: ONE donated XLA program whose
+        forward+backward segment is the mxnet_tpu.pp interleaved-1F1B
+        microbatch pipeline (vmapped stages over the 'pp' mesh axis,
+        collective-permute activation transfers, per-stage
+        recompute-backward), whose gradients arrive already ACCUMULATED
+        across microbatches, and whose optimizer segment is the very
+        same ``_make_param_update`` (ZeRO-1 over 'dp') the non-pipelined
+        step uses — 3D parallelism composed, not wired per model.
+
+        Under MXNET_PP_RESIDENT the stacked block weights come in as
+        'pp'-sharded slabs (stage-resident storage) and the pipeline
+        runs the shard_map-movement variant; otherwise the per-name
+        params are stacked in-program and rest replicated over pp (the
+        documented pre-residency behavior)."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import config as _config
+        from .. import pp as _pp
+        from ..base import get_env
+
+        plan = self._mesh_plan
+        pg = self._split_pp_graph()
+        param_specs = self._pp_param_specs()
         kind = get_env("MXNET_PP_SCHEDULE",
                        _config.describe("MXNET_PP_SCHEDULE").default, str)
+        update = self._make_param_update()
+        prologue = self._input_prologue
+
+        if getattr(self, "_pp_resident", False):
+            pipe = _pp.build_resident_pipeline_fn(
+                pg, plan, self._grad_param_names, param_specs,
+                self._pp_slab_sh, schedule_kind=kind)
+            self._pp_schedule = pipe.schedule
+            nonslab = list(self._pp_nonslab_grad_names)
+            slab_keys = list(self._pp_slab_keys)
+
+            def step(params, fixed, aux, states, inputs, key, lr, t):
+                rng = jax.random.fold_in(key, t)
+                if prologue is not None:
+                    inputs = prologue(inputs,
+                                      jax.random.fold_in(key, -1 - t),
+                                      True)
+                slabs = [params[k] for k in slab_keys]
+                args = dict(fixed)
+                args.update({n: params[n] for n in nonslab})
+                outs, grads, g_slabs = pipe(args, slabs, inputs, rng,
+                                            True)
+                grads = {n: grads.get(n, jnp.zeros_like(params[n]))
+                         for n in nonslab}
+                grads.update(dict(zip(slab_keys, g_slabs)))
+                t_f = (t + 1).astype(jnp.float32)
+                new_params, new_states = update(params, grads, states,
+                                                lr, t_f)
+                return (list(outs), new_params, dict(aux), new_states,
+                        t + 1)
+
+            return jax.jit(step, donate_argnums=(0, 3, 7))
+
         pipe = _pp.build_pipeline_fn(pg, plan, self._grad_param_names,
                                      param_specs, schedule_kind=kind)
         self._pp_schedule = pipe.schedule
-        update = self._make_param_update()
-        prologue = self._input_prologue
         pnames = list(self._grad_param_names)
 
         def step(params, fixed, aux, states, inputs, key, lr, t):
@@ -1030,25 +1306,45 @@ class Module(BaseModule):
         every full parameter on every device — the state and the update
         FLOPs are duplicated dp times.
 
-        ZeRO-1 mode (``self._zero``): every grad/param is flattened to
-        a dp-padded 1-D array and pinned to the 'dp'-sharded layout, so
-        XLA lowers the gradient psum + slice into a reduce-scatter;
-        ``optimizer.apply`` then touches only the local 1/dp shard
-        (sharded state, 1/dp of the update FLOPs and state bytes per
-        device); pinning the result back to the parameter's own layout
-        (replicated, or 'tp'-sharded) lowers to an all-gather.  The
-        update math is elementwise, so sharded and replicated runs
-        agree bit-for-bit up to fp reassociation of the gradient
-        reduction (see tests/test_zero.py)."""
+        ZeRO-1 mode (``self._zero``): gradients are flattened, padded
+        dp-divisible and packed into same-dtype BUCKETS of at most
+        ``MXNET_ZERO_BUCKET_BYTES`` emitted in BACKWARD order (the
+        reverse of parameter/forward order — the order gradients
+        become available during backward), each bucket a (dp, cols)
+        array whose row r concatenates every member param's rank-r
+        shard.  ONE reduce-scatter per bucket lands the summed shard,
+        ``optimizer.apply`` runs per param on its column slice (sharded
+        state, 1/dp of the update FLOPs and state bytes per device;
+        per-param lr/wd multipliers intact), and ONE all-gather per
+        bucket returns the updated columns, re-sliced locally into
+        each parameter's own layout (replicated, or 'tp'-sharded).
+        Decomposing the collective per bucket is what lets the async-
+        collective scheduler (MXNET_ASYNC_COLLECTIVES) run layer i's
+        reduce-scatter under layer i-1's backward compute — the
+        in-program analogue of the PR-3 CommScheduler.  The pack
+        layout is deterministic and per-lane, so bucketed, monolithic
+        (MXNET_ZERO_BUCKET_BYTES=0) and per-param programs agree
+        bit-for-bit up to fp reassociation of the gradient reduction
+        (tests/test_overlap.py pins bucketed == monolithic; see
+        tests/test_zero.py for sharded == replicated)."""
         import jax
         import jax.numpy as jnp
 
         optimizer = self._optimizer
-        pnames = list(self._grad_param_names)
+        resident = getattr(self, "_pp_resident", False)
+        pnames = list(self._fused_param_keys)
+        slab_keys = set(self._pp_slab_keys) if resident else set()
         lr_mult = {n: optimizer.lr_mult.get(n, 1.0) for n in pnames}
         wd_mult = {n: optimizer.wd_mult.get(n, 1.0) for n in pnames}
+        if resident:
+            for key, (lm, wm) in self._pp_slab_mults.items():
+                lr_mult[key], wd_mult[key] = lm, wm
+        slab_sh = (dict(zip(self._pp_slab_keys, self._pp_slab_sh))
+                   if resident else {})
 
         if not self._zero:
+            wsc0 = jax.lax.with_sharding_constraint
+
             def update(params, grads, states, lr, t_f):
                 new_params = {}
                 new_states = {}
@@ -1058,7 +1354,12 @@ class Module(BaseModule):
                                            optimizer.wd * wd_mult[n], t_f)
                     # the f32 lr scalar must not promote low-precision
                     # params
-                    new_params[n] = w.astype(params[n].dtype)
+                    w = w.astype(params[n].dtype)
+                    if n in slab_keys:
+                        # elementwise update of a stage-resident slab:
+                        # keep it pinned where it lives
+                        w = wsc0(w, slab_sh[n])
+                    new_params[n] = w
                     new_states[n] = jax.tree_util.tree_map(
                         lambda new, old: new.astype(old.dtype), s, states[n])
                 return new_params, new_states
@@ -1067,28 +1368,97 @@ class Module(BaseModule):
 
         wsc = jax.lax.with_sharding_constraint
         meta = self._zero_meta
-        dp_sh = self._mesh_plan.opt_state_sharding()
-        own_sh = {n: self._exec.arg_dict[n]._data.sharding for n in pnames}
-        shapes = {n: tuple(self._exec.arg_dict[n].shape) for n in pnames}
+        plan = self._mesh_plan
+        dp = plan.dp
+        dp_sh = plan.opt_state_sharding()
+        row_sh = plan.zero_bucket_sharding()
+        rep = plan.replicated()
+        own_sh = {n: self._exec.arg_dict[n]._data.sharding
+                  for n in pnames if n not in slab_keys}
+        shapes = {n: tuple(self._exec.arg_dict[n].shape)
+                  for n in pnames if n not in slab_keys}
+        buckets = self._zero_buckets
+        slab_meta = getattr(self, "_pp_slab_zero_meta", None) or {}
+        slab_state_sh = (plan.pp_opt_state_sharding() if resident
+                         else None)
+
+        def update_slab(key, w, g, st, lr, t_f):
+            """ZeRO over a stage-resident slab: per-stage flats
+            sharded (pp, dp) — reduce-scatter over 'dp' WITHIN each
+            stage, state and update touching 1/(pp*dp) of the slab
+            per device."""
+            shape, size, padded = slab_meta[key]
+            S = shape[0]
+            g2 = wsc(jnp.pad(jnp.reshape(g, (S, size)),
+                             ((0, 0), (0, padded - size))),
+                     slab_state_sh)
+            w2 = wsc(jnp.pad(jnp.reshape(w, (S, size)),
+                             ((0, 0), (0, padded - size))),
+                     slab_state_sh)
+            wn, sn = optimizer.apply(w2, g2, st, lr * lr_mult[key],
+                                     optimizer.wd * wd_mult[key], t_f)
+            new_state = jax.tree_util.tree_map(
+                lambda new, old: wsc(new.astype(old.dtype),
+                                     slab_state_sh), sn, st)
+            wn = jnp.reshape(wn[:, :size], shape).astype(w.dtype)
+            return wsc(wn, slab_sh[key]), new_state
 
         def update(params, grads, states, lr, t_f):
             new_params = {}
             new_states = {}
-            for n in pnames:
-                size, padded = meta[n]
-                gf = wsc(jnp.pad(jnp.reshape(grads[n], (size,)),
-                                 (0, padded - size)), dp_sh)  # reduce-scatter
-                wf = wsc(jnp.pad(jnp.reshape(params[n], (size,)),
-                                 (0, padded - size)), dp_sh)  # local slice
-                w, s = optimizer.apply(wf, gf, states[n],
-                                       lr * lr_mult[n],
-                                       optimizer.wd * wd_mult[n], t_f)
-                w = w.astype(params[n].dtype)
-                new_states[n] = jax.tree_util.tree_map(
-                    lambda new, old: new.astype(old.dtype), s, states[n])
-                # pad lanes (grad 0, state 0) never reach the weights
-                new_params[n] = wsc(jnp.reshape(w[:size], shapes[n]),
-                                    own_sh[n])  # all-gather
+            # stage-resident slabs first: the trunk's grads are the
+            # deepest of the backward
+            for key in (k for k in pnames if k in slab_keys):
+                new_params[key], new_states[key] = update_slab(
+                    key, params[key], grads[key], states[key], lr, t_f)
+            for bucket in buckets:  # backward (reverse-param) order
+                gcols, wcols, ks = [], [], []
+                for n in bucket:
+                    size, padded = meta[n]
+                    ks.append(padded // dp)
+                    gcols.append(jnp.pad(
+                        jnp.reshape(grads[n], (size,)),
+                        (0, padded - size)).reshape(dp, padded // dp))
+                    wcols.append(jnp.pad(
+                        jnp.reshape(params[n], (size,)),
+                        (0, padded - size)).reshape(dp, padded // dp))
+                cat = (lambda xs: xs[0] if len(xs) == 1
+                       else jnp.concatenate(xs, axis=1))
+                gb = wsc(cat(gcols), row_sh)  # ONE reduce-scatter/bucket
+                wb = wsc(cat(wcols), row_sh)  # local rows
+                ncols = []
+                c = 0
+                for n, k in zip(bucket, ks):
+                    gf = jax.lax.slice_in_dim(gb, c, c + k, axis=1)
+                    wf = jax.lax.slice_in_dim(wb, c, c + k, axis=1)
+                    # state stays checkpoint-compatible: stored flat
+                    # (padded,) 'dp'-sharded; the (dp, k) view is a
+                    # local reshape of the same lanes
+                    st = jax.tree_util.tree_map(
+                        lambda s, k=k: jnp.reshape(s, (dp, k)), states[n])
+                    w, s = optimizer.apply(wf, gf, st,
+                                           lr * lr_mult[n],
+                                           optimizer.wd * wd_mult[n], t_f)
+                    ncols.append(w.astype(params[n].dtype))
+                    new_states[n] = jax.tree_util.tree_map(
+                        lambda new, old: wsc(
+                            jnp.reshape(new.astype(old.dtype), old.shape),
+                            dp_sh),
+                        s, states[n])
+                    c += k
+                # ONE all-gather returns the whole updated bucket;
+                # per-param extraction below is local slicing
+                full = wsc(cat(ncols), rep)
+                c = 0
+                for n, k in zip(bucket, ks):
+                    size, padded = meta[n]
+                    flat = jnp.reshape(
+                        jax.lax.slice_in_dim(full, c, c + k, axis=1),
+                        (padded,))
+                    # pad lanes (grad 0, state 0) never reach the weights
+                    new_params[n] = wsc(jnp.reshape(flat[:size], shapes[n]),
+                                        own_sh[n])
+                    c += k
             return new_params, new_states
 
         return update
@@ -1103,9 +1473,14 @@ class Module(BaseModule):
             return
         self._grad_param_names = [n for n in self._param_names
                                   if self._exec.grad_req.get(n, "null") != "null"]
+        self._plan_pp_residency()
         self._init_zero_mode()
         self._fused_step = self._build_fused_step()
         self._apply_grads = self._build_apply_grads()
+        if getattr(self, "_pp_resident", False):
+            # the slab state builder consumes the slabs: build them now
+            # (frees the replicated per-name device buffers)
+            self._ensure_pp_slabs()
         self._fused_state = self._build_fused_state(dev)
         _prof.set_gauge("executor.opt_state_bytes",
                         self._opt_state_bytes_per_device())
@@ -1140,25 +1515,84 @@ class Module(BaseModule):
     def _init_zero_mode(self):
         """Decide whether this module's fused step runs the ZeRO-1
         sharded-optimizer update (MXNET_ZERO, default on whenever a
-        MeshPlan with dp>1 is active) and precompute the flat dp-padded
-        layout of every trainable param."""
+        MeshPlan with dp>1 is active), precompute the flat dp-padded
+        layout of every trainable param, and plan the gradient-
+        collective buckets (MXNET_ZERO_BUCKET_BYTES, backward order,
+        same-dtype — see _make_param_update)."""
         from ..base import get_env
 
         plan = self._mesh_plan
         self._zero = bool(plan is not None and plan.dp > 1
                           and get_env("MXNET_ZERO", 1, int))
         self._zero_meta = None
+        self._zero_buckets = None
+        self._pp_slab_zero_meta = None
         if not self._zero:
             return
         self._zero_meta = {}
         for n in self._grad_param_names:
             size = int(np.prod(self._exec.arg_dict[n].shape, dtype=np.int64))
             self._zero_meta[n] = (size, plan.zero_padded_size(size))
+        if getattr(self, "_pp_resident", False):
+            # slab keys update as (S, per-stage-flat) arrays sharded
+            # pp x dp: state bytes/device shrink by BOTH factors
+            self._pp_slab_zero_meta = {}
+            for key, names in zip(self._pp_slab_keys,
+                                  self._pp_slot_names):
+                shape = tuple(self._exec.arg_dict[names[0]].shape)
+                Ls = len(names) // plan.pp
+                size = Ls * int(np.prod(shape, dtype=np.int64))
+                self._pp_slab_zero_meta[key] = (
+                    (plan.pp, Ls) + shape, size,
+                    plan.zero_padded_size(size))
+        self._zero_buckets = self._plan_zero_buckets()
+
+    def _plan_zero_buckets(self):
+        """Deterministic same-dtype bucketing of the trainable params
+        in BACKWARD (reverse-parameter) order, capped at
+        MXNET_ZERO_BUCKET_BYTES per bucket (0 = no cap: one monolithic
+        bucket per dtype run — the serialized-collective baseline)."""
+        from .. import config as _config
+        from ..base import get_env
+
+        raw = get_env("MXNET_ZERO_BUCKET_BYTES", None, str)
+        if raw is None:
+            cap = int(_config.describe("MXNET_ZERO_BUCKET_BYTES").default)
+        else:
+            try:
+                cap = int(raw)
+            except (TypeError, ValueError):
+                raise MXNetError(
+                    f"MXNET_ZERO_BUCKET_BYTES={raw!r} is not an integer "
+                    "(want >= 0 bytes; 0 = one monolithic bucket)")
+            if cap < 0:
+                raise MXNetError(
+                    f"MXNET_ZERO_BUCKET_BYTES={cap} must be >= 0")
+        buckets = []
+        cur, cur_bytes, cur_dt = [], 0, None
+        names = (self._pp_nonslab_grad_names
+                 if getattr(self, "_pp_resident", False)
+                 else self._grad_param_names)
+        for n in reversed(names):
+            dt = self._exec.arg_dict[n].dtype
+            nbytes = self._zero_meta[n][1] * np.dtype(dt).itemsize
+            if cur and (dt != cur_dt
+                        or (cap > 0 and cur_bytes + nbytes > cap)):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(n)
+            cur_bytes += nbytes
+            cur_dt = dt
+        if cur:
+            buckets.append(cur)
+        return buckets
 
     def _build_fused_state(self, dev):
         """Allocate (or restore from a loaded checkpoint) the device-
         resident optimizer state for every trainable param — flat
-        'dp'-sharded in ZeRO mode, weight-shaped otherwise."""
+        'dp'-sharded in ZeRO mode, weight-shaped otherwise; slab keys
+        (stage residency) carry (S, per-stage-flat) pp x dp-sharded
+        state restacked from the per-name checkpoint entries."""
         import jax
         import jax.numpy as jnp
 
@@ -1167,7 +1601,10 @@ class Module(BaseModule):
         loaded = pending[1] if pending else {}
         states = {}
         fresh = []
-        for n in self._grad_param_names:
+        resident = getattr(self, "_pp_resident", False)
+        pernames = (self._pp_nonslab_grad_names if resident
+                    else self._grad_param_names)
+        for n in pernames:
             if n in loaded:
                 states[n] = self._place_state_tree(n, loaded[n], dev)
             elif self._zero:
@@ -1175,6 +1612,59 @@ class Module(BaseModule):
             else:
                 states[n] = self._optimizer.init_state_arrays(
                     self._exec.arg_dict[n]._data)
+        if resident:
+            fresh_slabs = []
+            for key, names in zip(self._pp_slab_keys,
+                                  self._pp_slot_names):
+                have = [n for n in names if n in loaded]
+                if not have:
+                    fresh_slabs.append(key)
+                elif len(have) != len(names):
+                    raise MXNetError(
+                        f"optimizer-state restore for pipeline slot "
+                        f"{names[0]!r} is incomplete: "
+                        f"{sorted(set(names) - set(have))} missing — "
+                        "a slab restores all of its layers or none")
+                else:
+                    states[key] = self._place_slab_state(
+                        key, [loaded[n] for n in names])
+            if fresh_slabs:
+                optimizer = self._optimizer
+                slab_idx = {k: i for i, k in
+                            enumerate(self._pp_slab_keys)}
+                if self._zero:
+                    smeta = self._pp_slab_zero_meta
+                    pp_sh = self._mesh_plan.pp_opt_state_sharding()
+
+                    def build_slab(slabs_in):
+                        out = {}
+                        for key, w in slabs_in.items():
+                            shape, size, padded = smeta[key]
+                            wf = jax.lax.with_sharding_constraint(
+                                jnp.pad(
+                                    jnp.reshape(w, (shape[0], size)),
+                                    ((0, 0), (0, padded - size))),
+                                pp_sh)
+                            out[key] = optimizer.\
+                                init_state_arrays_sharded(wf, pp_sh)
+                        return out
+                else:
+                    slab_sh = dict(zip(self._pp_slab_keys,
+                                       self._pp_slab_sh))
+
+                    def build_slab(slabs_in):
+                        out = {}
+                        for key, w in slabs_in.items():
+                            st = optimizer.init_state_arrays(w)
+                            out[key] = jax.tree_util.tree_map(
+                                lambda a: jax.lax.
+                                with_sharding_constraint(a, slab_sh[key]),
+                                st)
+                        return out
+
+                states.update(jax.jit(build_slab)(
+                    {k: self._pp_slabs[slab_idx[k]]
+                     for k in fresh_slabs}))
         if fresh:
             # ONE jitted builder for every fresh sharded state — a
             # per-param jit would pay one XLA compile per parameter
@@ -1195,6 +1685,38 @@ class Module(BaseModule):
             states.update(jax.jit(build)(
                 {n: self._exec.arg_dict[n]._data for n in fresh}))
         return states
+
+    def _place_slab_state(self, key, member_trees):
+        """Per-name host state trees (param-shaped, one per layer) →
+        this slab's device state: stacked (S, Ls, ...) then flattened
+        per stage and scattered pp x dp under ZeRO, or placed slab-
+        shaped otherwise."""
+        import jax
+
+        plan = self._mesh_plan
+        slot = self._pp_slab_keys.index(key)
+        S = plan.pp
+
+        if self._zero:
+            shape, size, padded = self._pp_slab_zero_meta[key]
+            pp_sh = plan.pp_opt_state_sharding()
+
+            def put(*leaves):
+                h = np.stack([np.asarray(a) for a in leaves])
+                h = np.pad(h.reshape(S, size),
+                           ((0, 0), (0, padded - size)))
+                return plan.place(h, pp_sh)
+
+            return jax.tree_util.tree_map(put, *member_trees)
+
+        sh = self._pp_slab_sh[slot]
+
+        def put(*leaves):
+            h = np.stack([np.asarray(a) for a in leaves])
+            h = h.reshape((S, len(member_trees) // S) + h.shape[1:])
+            return plan.place(h, sh)
+
+        return jax.tree_util.tree_map(put, *member_trees)
 
     def _place_state_tree(self, name, host_tree, dev):
         """Host (param-shaped) state tree → device arrays in this
@@ -1262,16 +1784,34 @@ class Module(BaseModule):
 
     def _update_with_fused_state(self):
         """Apply grad_dict gradients through the fused optimizer state
-        (the get_outputs()-fallback companion of _run_fused_step)."""
+        (the get_outputs()-fallback companion of _run_fused_step).
+
+        Under stage residency the plain path just ran on materialized
+        per-name params/grads; the per-name block grads are re-stacked
+        host-side into slab gradients so the ONE slab-keyed optimizer
+        state keeps advancing (edge path — the steady state never
+        leaves the fused step)."""
         dev = self._context[0].jax_device()
         self._ensure_fused_built(dev)
         grads = {}
         for n in self._grad_param_names:
             g = self._exec.grad_dict.get(n)
-            if g is None:
+            if g is None or g._data.is_deleted():
                 return False
             grads[n] = g._data
-        params = {n: self._exec.arg_dict[n]._data for n in self._grad_param_names}
+        if getattr(self, "_pp_resident", False):
+            from ..ndarray import gather_global
+
+            plan = self._mesh_plan
+            for key, names, sh in zip(self._pp_slab_keys,
+                                      self._pp_slot_names,
+                                      self._pp_slab_sh):
+                host = np.stack([np.asarray(gather_global(grads.pop(n)))
+                                 for n in names])
+                host = host.reshape((plan.pp, len(names) // plan.pp)
+                                    + host.shape[1:])
+                grads[key] = plan.place(host, sh)
+        params = self._collect_fused_params()
         self._step_count += 1
         self._optimizer._update_count(0)
         params = _copy_donated_aliases(
@@ -1279,8 +1819,7 @@ class Module(BaseModule):
         new_params, self._fused_state, self._fused_t = self._apply_grads(
             params, grads, self._fused_state, self._lr_device(dev),
             self._fused_t)
-        for n, v in new_params.items():
-            self._exec.arg_dict[n]._set_data(v)
+        self._store_fused_params(new_params)
         return True
 
     def _build_apply_grads(self):
@@ -1344,7 +1883,7 @@ class Module(BaseModule):
 
         self._ensure_fused_built(dev)
 
-        params = {n: self._exec.arg_dict[n]._data for n in self._grad_param_names}
+        params = self._collect_fused_params()
         fixed = {n: self._exec.arg_dict[n]._data for n in self._param_names
                  if n not in self._grad_param_names}
         aux = {n: a._data for n, a in self._exec.aux_dict.items()}
@@ -1376,8 +1915,7 @@ class Module(BaseModule):
         _prof.record_program("Module.fused_step", t_start,
                              time.perf_counter() - t_start, compiled,
                              args={"step": self._step_count})
-        for n, v in new_params.items():
-            self._exec.arg_dict[n]._set_data(v)
+        self._store_fused_params(new_params)
         for n, v in new_aux.items():
             self._exec.aux_dict[n]._set_data(v)
         self._fused_state = new_states
@@ -1409,10 +1947,15 @@ class Module(BaseModule):
             tracker.set_pp_bubble(
                 (plan.pp - 1) / (plan.microbatches + plan.pp - 1))
         try:
+            # specs carry shardings so the SAME trees can later lower
+            # the SPMD program for fused_hlo_text() — the lowered
+            # (pre-partitioning) cost analysis below is unaffected
             specs = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
-                                               jnp.result_type(a)),
+                lambda a: jax.ShapeDtypeStruct(
+                    jnp.shape(a), jnp.result_type(a),
+                    sharding=getattr(a, "sharding", None)),
                 step_args)
+            self._fused_arg_specs = specs
             cost = self._fused_step.lower(*specs).cost_analysis()
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else None
@@ -1422,6 +1965,82 @@ class Module(BaseModule):
                 tracker.set_flops_per_step(flops / max(ndev, 1))
         except Exception:  # noqa: BLE001 — accounting must never
             pass  # break the training step
+
+    def _fused_compiled(self):
+        """The compiled (SPMD-partitioned) fused step, re-lowered from
+        the arg specs captured at the first run.  Costs ONE extra XLA
+        compile per built step — cached on the module so the HLO text,
+        the memory analysis and the comm-fraction cost read all share
+        it."""
+        specs = getattr(self, "_fused_arg_specs", None)
+        if specs is None or self._fused_step is None:
+            raise MXNetError(
+                "needs a built fused step: run one training step "
+                "first (forward_backward + update)")
+        cache = getattr(self, "_fused_hlo_cache", None)
+        if cache is not None and cache[0] is self._fused_step:
+            return cache[1]
+        compiled = self._fused_step.lower(*specs).compile()
+        self._fused_hlo_cache = (self._fused_step, compiled)
+        return compiled
+
+    def fused_hlo_text(self):
+        """Compiled (scheduled, SPMD-partitioned) HLO text of the
+        fused training step — the artifact the comm/compute-overlap
+        inspection reads (``mxnet_tpu.hlo.overlap_report``;
+        tests/test_overlap.py, tools/bench_pp.py, PERF.md evidence).
+
+        Costs one extra XLA compile of the program the first time
+        (cached per built step afterwards); call after at least one
+        fused step has run."""
+        return self._fused_compiled().as_text()
+
+    def fused_memory_analysis(self):
+        """Per-device compiled memory breakdown of the fused step
+        (argument/temp/output bytes) — bench_pp's
+        ``weight_bytes_per_device`` / stash-bytes evidence."""
+        return self._fused_compiled().memory_analysis()
+
+    def account_program_comm(self):
+        """Attribute IN-PROGRAM collective time to the goodput
+        tracker's step decomposition: the static collective fraction
+        = collective bytes / total bytes accessed, both read from the
+        compiled fused step (the same XLA cost surface training.mfu
+        uses).  Without this, fused-program collectives silently book
+        as ``compute`` — only host-side CommScheduler waits were
+        counted.  Returns the fraction, or None when it cannot be
+        computed (no mesh, program not built, toolchain without a
+        cost model).  fit() calls this once per built program (step 8,
+        or step 1 when the ops endpoint is live); the one extra
+        compile it costs is cached by fused_hlo_text."""
+        plan = self._mesh_plan
+        if plan is None or plan.num_devices <= 1 \
+                or self._fused_step is None:
+            return None
+        # once per BUILT program: a rebuild (new prologue, re-mesh)
+        # invalidates this identity and re-accounts at the next call —
+        # a stale mesh's fraction must not keep booking
+        if getattr(self, "_comm_accounted_for", None) \
+                is self._fused_step:
+            return self._program_comm_fraction
+        from .. import hlo as _hlo
+
+        try:
+            compiled = self._fused_compiled()  # ONE compile, cached
+            cbytes = _hlo.collective_bytes(compiled.as_text())
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else None
+            total = float((cost or {}).get("bytes accessed", 0.0))
+            # both numbers are per-device (post-partitioning); cap the
+            # fraction — a decomposition 100% comm would zero compute
+            frac = min(cbytes / max(total, float(cbytes), 1.0), 0.9)
+            self._program_comm_fraction = frac
+            self._comm_accounted_for = self._fused_step
+            _prof.goodput_tracker().set_program_comm_fraction(frac)
+            return frac
+        except Exception:  # noqa: BLE001 — accounting must never
+            return None  # break the training step
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -1435,6 +2054,7 @@ class Module(BaseModule):
             self._pending_batch = None
             if self._input_prologue is not None:
                 kwargs = self._apply_prologue_host(kwargs, True)
+            self._materialize_pp_params()
             self._exec.forward(is_train=True, **kwargs)
             if all(r in ("write", "null")
                    for r in self._exec.grad_req.values()):
@@ -1523,8 +2143,32 @@ class Module(BaseModule):
 
         from ..ndarray import gather_global
 
+        resident = getattr(self, "_pp_resident", False)
+        slab_names = (dict(zip(self._pp_slab_keys, self._pp_slot_names))
+                      if resident else {})
         states = {}
         for n, tree in self._fused_state.items():
+            if n in slab_names:
+                # slab state → per-name param-shaped entries, so the
+                # checkpoint stays layout-independent (loads into
+                # resident, replicated-pp, dp-only or eager runs alike)
+                names = slab_names[n]
+                L = len(names)
+                pshape = tuple(self._exec.arg_dict[names[0]].shape)
+
+                def slab_to_host(a, L=L, pshape=pshape, key=n):
+                    h = gather_global(a)
+                    if self._zero:
+                        _shape, size, _padded = \
+                            self._pp_slab_zero_meta[key]
+                        h = h[:, :size]
+                    return np.asarray(h).reshape((L,) + pshape)
+
+                host = jax.tree_util.tree_map(slab_to_host, tree)
+                for l, name in enumerate(names):
+                    states[name] = jax.tree_util.tree_map(
+                        lambda t, l=l: t[l], host)
+                continue
             shape = tuple(self._exec.arg_dict[n].shape)
             size = self._zero_meta[n][0] if self._zero else None
 
@@ -1559,10 +2203,26 @@ class Module(BaseModule):
         import jax.numpy as jnp
 
         dev = self._context[0].jax_device()
+        resident = getattr(self, "_pp_resident", False)
+        slab_members = self._pp_slab_members if resident else set()
         for n in self._grad_param_names:
-            if n in states_by_name:
+            if n in states_by_name and n not in slab_members:
                 self._fused_state[n] = self._place_state_tree(
                     n, states_by_name[n], dev)
+        if resident:
+            for key, names in zip(self._pp_slab_keys,
+                                  self._pp_slot_names):
+                have = [n for n in names if n in states_by_name]
+                if not have:
+                    continue
+                if len(have) != len(names):
+                    raise MXNetError(
+                        f"optimizer-state restore for pipeline slot "
+                        f"{names[0]!r} is incomplete: "
+                        f"{sorted(set(names) - set(have))} missing — "
+                        "a slab restores all of its layers or none")
+                self._fused_state[key] = self._place_slab_state(
+                    key, [states_by_name[n] for n in names])
         if self._mesh_plan is not None:
             self._fused_t = self._mesh_plan.place(
                 np.int32(self._step_count), self._mesh_plan.replicated())

@@ -441,6 +441,46 @@ class MeshPlan:
         zero-padded to it so every 'dp' rank owns an equal shard."""
         return -(-int(size) // self.dp) * self.dp
 
+    def zero_bucket_sharding(self):
+        """Layout of one gradient-collective BUCKET in the ZeRO-1
+        update segment: a (dp, columns) array whose row dim partitions
+        over the ``zero`` axis ('dp' unless the rules remap it) and
+        whose columns — the concatenation of every member param's
+        per-rank shard — stay local.  Row r of the bucket is exactly
+        the concatenation of rank r's per-param flat shards, so
+        per-param column slices never cross shard boundaries: ONE
+        reduce-scatter feeds the whole bucket and ONE all-gather
+        returns it (MXNET_ZERO_BUCKET_BYTES; Module._make_param_update
+        emits buckets in backward order)."""
+        from jax.sharding import PartitionSpec as P
+
+        ax = self.rules.spec(("zero",), param="<opt-state>")[0]
+        return self._named(P(ax, None))
+
+    def pp_opt_state_sharding(self):
+        """ZeRO-1 state layout for a STAGE-RESIDENT slab: (S,
+        per-stage-padded-flat) arrays with dim 0 over 'pp' and dim 1
+        over the ``zero`` axis — each device stores and updates
+        1/(pp*dp) of the slab's Adam/momentum slots."""
+        from jax.sharding import PartitionSpec as P
+
+        ax = self.rules.spec(("zero",), param="<opt-state>")[0]
+        return self._named(P("pp", ax))
+
+    def pp_param_sharding(self, spec: Sequence[Optional[str]]):
+        """Stage-resident placement of one stacked block-parameter
+        slab (S, L/S, ...): dim 0 over 'pp', the weight dims keeping
+        their rules-table mesh axes (``spec`` is the per-layer param's
+        resolved PartitionSpec tuple) — MXNET_PP_RESIDENT storage."""
+        from jax.sharding import PartitionSpec as P
+
+        if "pp" in tuple(spec):
+            raise MXNetError(
+                f"stacked block param already maps a weight dim to "
+                f"'pp' ({tuple(spec)}); the slab's stage dim owns that "
+                "axis")
+        return self._named(P(*(("pp", None) + tuple(spec))))
+
     def _legacy_shard_axes(self, ndim: int, attr: str, name: str):
         """The ``__shard__`` deprecation shim: synthesize a single-param
         rule from an "axis:dim" attr and return logical axes that hit

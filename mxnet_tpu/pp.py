@@ -57,7 +57,8 @@ import numpy as np
 from .base import MXNetError
 
 __all__ = ["build_schedule", "Schedule", "split_blocks", "PipelineGraph",
-           "build_pipeline_fn", "bubble_fraction"]
+           "build_pipeline_fn", "build_resident_pipeline_fn",
+           "bubble_fraction"]
 
 
 # ---------------------------------------------------------------------------
@@ -864,6 +865,344 @@ def build_pipeline_fn(pg: PipelineGraph, plan, grad_names: Sequence[str],
         outputs = [os.reshape((os.shape[0] * os.shape[1],)
                               + tuple(os.shape[2:])) for os in out_stash]
         return outputs, grads
+
+    fn.schedule = sched
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Stage-resident pipelined forward+backward (MXNET_PP_RESIDENT)
+# ---------------------------------------------------------------------------
+
+def _manual_pp(mesh, in_specs, out_specs):
+    """Full-manual shard_map over the whole mesh — the stage-axis data
+    movement of the resident pipeline runs through these tiny bodies
+    (ppermute / psum / per-stage take/select along the microbatch dim)
+    so the SPMD partitioner NEVER handles a 'pp'-sharded carry update:
+    the documented MXNET_PP_CONSTRAIN miscompile (roll/one-hot updates
+    of a 'pp'-sharded scan carry) has no surface to fire on."""
+    import jax
+
+    def wrap(f):
+        if hasattr(jax, "shard_map"):  # jax >= 0.6 spelling
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map(f, mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+    return wrap
+
+
+def build_resident_pipeline_fn(pg: PipelineGraph, plan,
+                               grad_names: Sequence[str],
+                               param_specs: Dict[str, Any],
+                               slab_shardings: Sequence[Any],
+                               schedule_kind: str = "1f1b"):
+    """The STAGE-RESIDENT pipelined fwd+bwd: block parameters arrive
+    as per-slot slabs stacked (S, L/S, ...) and sharded
+    ``P('pp', ...)`` — each pipeline stage's devices hold only their
+    own layers' weights (~1/pp the bytes; the placement the
+    partitioner bug forfeited).  Returns ``f(args, slabs, inputs,
+    rng, is_train) -> (outputs, grads, slab_grads)`` where ``grads``
+    covers the pre/post-region parameters and ``slab_grads`` are the
+    per-slot gradient slabs, pinned to the slab sharding.
+
+    Correctness strategy vs the documented jaxlib hazard: the stash
+    and cotangent carries stay pinned to their stage-resident layout,
+    but every operation that MOVES data across or indexes along the
+    stage axis — the inter-stage activation roll, the microbatch-slot
+    scatter/gather, the exit/entry-stage broadcast — is an explicit
+    full-manual ``shard_map`` body (``ppermute``/``psum``/local
+    selects), not a partitioned ``jnp.roll``/one-hot update.  The
+    compute GSPMD sees is the vmapped stage chain over 'pp'-sharded
+    operands plus elementwise masking — patterns it partitions
+    trivially.  Equivalence vs the replicated path is pinned by
+    tests/test_pp.py.
+
+    Numerics are IDENTICAL to :func:`build_pipeline_fn` by
+    construction: same schedule, same per-(microbatch, layer, node)
+    RNG streams, same accumulation order."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    S = plan.pp
+    M = plan.microbatches
+    L = pg.num_layers
+    if L % S != 0:
+        raise MXNetError(
+            f"{L} pipeline blocks do not divide into pp={S} stages; "
+            "choose pp dividing the layer count")
+    Ls = L // S
+    if plan.batch_axis != 0:
+        raise MXNetError("pipeline parallelism requires batch_axis=0")
+    sched = build_schedule(M, S, schedule_kind)
+    pre_fn, block_fn, post_fn = _region_fns(pg)
+    grad_set = set(grad_names)
+    pre_grads = [n for n in pg.pre_params if n in grad_set]
+    post_grads = [n for n in pg.post_params if n in grad_set]
+    wsc = jax.lax.with_sharding_constraint
+    mesh = plan.mesh
+
+    def act_spec(ndim):
+        axes = pg.boundary_axes
+        if axes is None or len(axes) != ndim:
+            axes = ("batch",) + (None,) * (ndim - 1)
+        return tuple(plan.activation_spec(axes, param="<pp-carry>"))
+
+    def fn(args, slabs, inputs, rng, is_train=True):
+        # ---- microbatch the inputs (global batch, dim 0)
+        micro = {}
+        for k, v in inputs.items():
+            B = v.shape[0]
+            if B % M:
+                raise MXNetError(
+                    f"input {k!r} batch {B} not divisible by "
+                    f"microbatches={M}")
+            micro[k] = v.reshape((M, B // M) + tuple(v.shape[1:]))
+
+        keys_m = jax.vmap(lambda m: jax.random.fold_in(rng, m))(
+            jnp.arange(M))
+        layer_ids = jnp.arange(L).reshape(S, Ls)
+
+        def block_key(m_key, layer_id):
+            return jax.random.fold_in(m_key, 1 + layer_id)
+
+        # ---- pre (embedding...) over every microbatch up front
+        def run_pre(mi, key):
+            return pre_fn(args, mi, key, is_train)
+
+        e = jax.vmap(run_pre)({k: v for k, v in micro.items()}, keys_m)
+        aspec = act_spec(e.ndim - 1)
+        carry_sh = NamedSharding(mesh, P(*((None,) + aspec)))
+        e = wsc(e, carry_sh)
+
+        # stage-axis movement helpers (see _manual_pp): specs of the
+        # (S, Bm, ...) wave, the (S, M, Bm, ...) stash, and (S,) vecs
+        y_spec = P(*(("pp",) + aspec))
+        stash_spec = P(*(("pp", None) + aspec))
+        vec_spec = P("pp")
+        y_sh = NamedSharding(mesh, y_spec)
+        stash_sh = NamedSharding(mesh, stash_spec)
+
+        def ring_shift(y, shift):
+            """Stage s's wave row → stage s+shift (wraps; the wrapped
+            entry is masked by the caller's scatter vector)."""
+            perm = [(i, (i + shift) % S) for i in range(S)]
+            body = _manual_pp(mesh, (y_spec,), y_spec)(
+                lambda v: jax.lax.ppermute(v, "pp", perm))
+            return body(y)
+
+        def stage_bcast(y_masked):
+            """(S, Bm, ...) wave with exactly one unmasked stage row →
+            that row, replicated over 'pp' (a psum of zeros
+            elsewhere)."""
+            body = _manual_pp(mesh, (y_spec,), P(*aspec))(
+                lambda v: jax.lax.psum(v[0], "pp"))
+            return body(y_masked)
+
+        def gather_m(buf, idx):
+            """Per-stage pick along the microbatch dim: local
+            take_along_axis on each stage's own (1, M, ...) shard."""
+            def body(b, i):
+                ix = i.reshape((b.shape[0],) + (1,) * (b.ndim - 1))
+                return jnp.take_along_axis(b, ix, axis=1)[:, 0]
+
+            return _manual_pp(mesh, (stash_spec, vec_spec),
+                              y_spec)(body)(buf, idx)
+
+        def scatter_m(buf, idx, act, val):
+            """Per-stage masked write along the microbatch dim: a
+            local where-select on each stage's shard."""
+            def body(b, i, a, v):
+                onehot = (jnp.arange(M)[None, :] == i[:, None]) \
+                    & a[:, None]
+                mask = onehot.reshape(b.shape[:2]
+                                      + (1,) * (b.ndim - 2))
+                return jnp.where(mask, v[:, None], b)
+
+            return _manual_pp(
+                mesh, (stash_spec, vec_spec, vec_spec, y_spec),
+                stash_spec)(body)(buf, idx, act, val)
+
+        def stage_chain(ws, x, m_key, lids):
+            for j in range(Ls):
+                x = block_fn([w[j] for w in ws], x,
+                             block_key(m_key, lids[j]), is_train)
+            return x
+
+        # ---- pipeline state: stash[0] seeds from the pre output on
+        # the entry stage via an elementwise stage-mask select (no
+        # indexed update of the 'pp'-sharded dim)
+        Bm_shape = tuple(e.shape[1:])
+        first = (jnp.arange(S) == 0).reshape((S,) + (1,) * (e.ndim))
+        last_y = (jnp.arange(S) == S - 1).reshape(
+            (S,) + (1,) * (e.ndim - 1))
+        stash = jnp.zeros((S, M) + Bm_shape, e.dtype)
+        stash = wsc(jnp.where(first, e[None], stash), stash_sh)
+        cot = wsc(jnp.zeros((S, M) + Bm_shape, e.dtype), stash_sh)
+        h_stash = jnp.zeros((M,) + Bm_shape, e.dtype)
+        de_stash = jnp.zeros((M,) + Bm_shape, e.dtype)
+        g_slabs = [wsc(jnp.zeros_like(w), sh)
+                   for w, sh in zip(slabs, slab_shardings)]
+        g_post = {n: jnp.zeros_like(args[n]) for n in post_grads}
+
+        probe = jax.eval_shape(
+            lambda h, mi, k: post_fn(args, mi, h, k, is_train),
+            jax.ShapeDtypeStruct(Bm_shape, e.dtype),
+            {k: jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
+             for k, v in micro.items()},
+            jax.ShapeDtypeStruct((2,), jnp.uint32))
+        for i, p in enumerate(probe):
+            if len(p.shape) == 0:
+                raise MXNetError(
+                    f"pipeline execution requires batch-major outputs; "
+                    f"output {i} of {pg.symbol.list_outputs()[i]!r} is a "
+                    "scalar — keep per-example loss heads (e.g. "
+                    "SoftmaxOutput/SoftmaxCELoss) under pp > 1")
+        out_stash = [jnp.zeros((M,) + tuple(p.shape), p.dtype)
+                     for p in probe]
+
+        def fwd_wave(state, fvec, fdst):
+            stash, h_stash = state
+            f_act = fvec >= 0
+            f_idx = jnp.clip(fvec, 0, M - 1)
+            x_in = gather_m(stash, f_idx)
+            y = jax.vmap(stage_chain)(slabs, x_in, keys_m[f_idx],
+                                      layer_ids)
+            y = jnp.where(f_act.reshape((S,) + (1,) * (y.ndim - 1)),
+                          y, jnp.zeros_like(y))
+            y = wsc(y, y_sh)
+            # the exit stage's output must reach the (pp-replicated)
+            # h_stash the post vjp reads: one explicit broadcast
+            mS = f_idx[S - 1]
+            h_val = stage_bcast(jnp.where(last_y, y,
+                                          jnp.zeros_like(y)))
+            h_stash = h_stash.at[mS].set(
+                jnp.where(f_act[S - 1], h_val, h_stash[mS]))
+            # stage s-1's output → stage s's stash slot: explicit
+            # ppermute instead of a partitioned roll
+            y_shift = ring_shift(y, 1)
+            stash = scatter_m(stash, jnp.clip(fdst, 0, M - 1),
+                              fdst >= 0, y_shift)
+            return wsc(stash, stash_sh), h_stash
+
+        def bwd_wave(state, bvec, bsrc):
+            (stash, cot, h_stash, de_stash, out_stash, g_slabs,
+             g_post) = state
+            b_act = bvec >= 0
+            b_idx = jnp.clip(bvec, 0, M - 1)
+            mB = b_idx[S - 1]
+            mi_B = {k: v[mB] for k, v in micro.items()}
+            lact = b_act[S - 1]
+
+            def post_for(pp_, h):
+                merged = dict(args)
+                merged.update(pp_)
+                return tuple(post_fn(merged, mi_B, h, keys_m[mB],
+                                     is_train))
+
+            p_post = {n: args[n] for n in post_grads}
+
+            def run_post(h_in):
+                outs_m, post_vjp = jax.vjp(post_for, p_post, h_in)
+                heads = tuple(jnp.ones(o.shape, o.dtype)
+                              for o in outs_m)
+                dpost, dh = post_vjp(heads)
+                return tuple(outs_m), dpost, dh.astype(h_in.dtype)
+
+            def skip_post(h_in):
+                return (tuple(jnp.zeros(p.shape, p.dtype)
+                              for p in probe),
+                        {n: jnp.zeros_like(args[n])
+                         for n in post_grads},
+                        jnp.zeros_like(h_in))
+
+            outs_m, dpost, dh = jax.lax.cond(lact, run_post, skip_post,
+                                             h_stash[mB])
+            out_stash = [os.at[mB].set(jnp.where(lact, om, os[mB]))
+                         for os, om in zip(out_stash, outs_m)]
+            g_post = {n: g + jnp.where(lact, dpost[n],
+                                       jnp.zeros_like(g))
+                      for n, g in g_post.items()}
+            cot_in = gather_m(cot, b_idx)
+            # the exit stage's incoming cotangent is the post vjp's dh
+            # (pp-replicated): an elementwise stage-mask select
+            cot_in = jnp.where(last_y, dh[None].astype(cot_in.dtype),
+                               cot_in)
+            cot_in = wsc(cot_in, y_sh)
+            x_b = gather_m(stash, b_idx)
+
+            def stage_bwd(ws, xi, ci, m_key, lids):
+                _y, vjp = jax.vjp(
+                    lambda w, x: stage_chain(w, x, m_key, lids), ws, xi)
+                dws, dx = vjp(ci)
+                return dws, dx
+
+            dws, dx = jax.vmap(stage_bwd)(slabs, x_b, cot_in,
+                                          keys_m[b_idx], layer_ids)
+            g_slabs = [
+                wsc(g + jnp.where(
+                    b_act.reshape((S,) + (1,) * (g.ndim - 1)),
+                    dw, jnp.zeros_like(g)), sh)
+                for g, dw, sh in zip(g_slabs, dws, slab_shardings)]
+            dx = jnp.where(b_act.reshape((S,) + (1,) * (dx.ndim - 1)),
+                           dx, jnp.zeros_like(dx))
+            dx = wsc(dx, y_sh)
+            # the entry stage's input-cotangent feeds the (replicated)
+            # de_stash the pre backward reads: explicit broadcast
+            m0 = b_idx[0]
+            first_y = (jnp.arange(S) == 0).reshape(
+                (S,) + (1,) * (dx.ndim - 1))
+            de_val = stage_bcast(jnp.where(first_y, dx,
+                                           jnp.zeros_like(dx)))
+            de_stash = de_stash.at[m0].set(
+                jnp.where(b_act[0], de_val, de_stash[m0]))
+            # stage s+1's input-cotangent → stage s: reverse ppermute
+            dx_shift = ring_shift(dx, -1)
+            cot = scatter_m(cot, jnp.clip(bsrc, 0, M - 1), bsrc >= 0,
+                            dx_shift)
+            return (stash, wsc(cot, stash_sh), h_stash, de_stash,
+                    out_stash, g_slabs, g_post)
+
+        def tick(state, xs):
+            fvec, bvec, fdst, bsrc = xs
+            (stash, cot, h_stash, de_stash, out_stash, g_slabs,
+             g_post) = state
+            stash, h_stash = fwd_wave((stash, h_stash), fvec, fdst)
+            state = bwd_wave((stash, cot, h_stash, de_stash, out_stash,
+                              g_slabs, g_post), bvec, bsrc)
+            return state, None
+
+        xs = (jnp.asarray(sched.fwd), jnp.asarray(sched.bwd),
+              jnp.asarray(sched.fwd_dst), jnp.asarray(sched.bwd_src))
+        state0 = (stash, cot, h_stash, de_stash, out_stash, g_slabs,
+                  g_post)
+        state, _ = jax.lax.scan(tick, state0, xs)
+        (_stash, _cot, _h, de_stash, out_stash, g_slabs,
+         g_post) = state
+
+        # ---- pre backward (all microbatches at once)
+        def pre_for(pp_):
+            merged = dict(args)
+            merged.update(pp_)
+            return jax.vmap(lambda mi, k: pre_fn(merged, mi, k, is_train)
+                            )({k: v for k, v in micro.items()}, keys_m)
+
+        p_pre = {n: args[n] for n in pre_grads}
+        _e, pre_vjp = jax.vjp(pre_for, p_pre)
+        (g_pre,) = pre_vjp(de_stash.astype(e.dtype))
+
+        grads: Dict[str, Any] = {}
+        for src in (g_pre, g_post):
+            for n, g in src.items():
+                grads[n] = grads[n] + g if n in grads else g
+
+        outputs = [os.reshape((os.shape[0] * os.shape[1],)
+                              + tuple(os.shape[2:])) for os in out_stash]
+        return outputs, grads, g_slabs
 
     fn.schedule = sched
     return fn

@@ -92,6 +92,7 @@ __all__ = ["profiler_set_config", "profiler_set_state", "dump_profile",
            "dump_flight_record", "read_flight_file", "GoodputTracker",
            "goodput_tracker", "device_peak_flops", "MetricsServer",
            "start_metrics_server", "maybe_start_metrics_server",
+           "metrics_server_running",
            "register_statusz", "unregister_statusz", "statusz"]
 
 
@@ -1086,6 +1087,7 @@ class GoodputTracker:
             self._peak_resolved = False
             self._pp_bubble = 0.0
             self._pending_comm = 0.0
+            self._program_comm_frac = 0.0
             self._steps = 0
             self._step_s_ema = None
             self._cum = {"compute": 0.0, "comm": 0.0, "io_wait": 0.0,
@@ -1112,6 +1114,20 @@ class GoodputTracker:
         step — attributed out of compute in the decomposition."""
         with self._lock:
             self._pp_bubble = min(max(float(frac), 0.0), 1.0)
+
+    def set_program_comm_fraction(self, frac):
+        """Static IN-PROGRAM collective fraction of one fused step —
+        collective bytes / total bytes accessed, both from the XLA
+        cost surface of the compiled step
+        (``Module.account_program_comm``).  Before this, ``comm`` was
+        booked only from host-side CommScheduler waits, so the
+        reduce-scatter/all-gather running INSIDE the one fused XLA
+        program silently reported as ``compute``.  Each step sample
+        books ``frac`` of its in-step seconds as comm (on top of any
+        scheduler waits, capped at the step); the fractions keep
+        summing to 1 by construction."""
+        with self._lock:
+            self._program_comm_frac = min(max(float(frac), 0.0), 1.0)
 
     # -- attribution hooks -----------------------------------------------
     def add_comm(self, seconds):
@@ -1149,7 +1165,9 @@ class GoodputTracker:
             wall = max(now - self._t_last, step_s + io_s + ckpt_s)
             self._wall_s += wall
             self._t_last = now
-            comm = min(self._pending_comm, step_s)
+            in_program = self._program_comm_frac \
+                * max(step_s - min(self._pending_comm, step_s), 0.0)
+            comm = min(self._pending_comm + in_program, step_s)
             self._pending_comm = 0.0
             bubble = self._pp_bubble * max(step_s - comm, 0.0)
             compute = max(step_s - comm - bubble, 0.0)
@@ -1202,6 +1220,7 @@ class GoodputTracker:
                 "peak_flops": self._peak,
                 "mfu": (self._flops / max(mean_step, 1e-9) / self._peak
                         if self._flops and self._peak else None),
+                "program_comm_fraction": self._program_comm_frac,
                 "lost_s": dict(self._lost),
             }
             total = max(sum(self._cum.values()), 1e-9)
@@ -1357,6 +1376,14 @@ def start_metrics_server(port: int | None = None,
             raise _mx_error(f"metrics port {port} out of range")
         _metrics_server = MetricsServer(port=port, host=host)
         return _metrics_server
+
+
+def metrics_server_running() -> bool:
+    """True when THE process metrics server is up (an operator is
+    watching /statusz — the fit loop uses this to decide whether the
+    in-program comm attribution is worth its one extra compile at
+    step 1 instead of step 8)."""
+    return _metrics_server is not None
 
 
 def maybe_start_metrics_server():

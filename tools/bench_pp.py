@@ -1,13 +1,21 @@
 #!/usr/bin/env python
 """Pipeline-parallel (pp) training benchmark: closed-loop fused-step
 throughput on the 8-device CPU mesh, sweeping the microbatch count,
-against the dp-only baseline on the SAME devices.
+against the dp-only baseline on the SAME devices — plus the
+stage-residency memory evidence (MXNET_PP_RESIDENT) and the
+comm/compute-overlap structure of the compiled step.
 
 Prints ONE JSON line (the `bench.py` convention):
 
   {"metric": "pp_train_throughput", "value": <best samples/s>,
    "unit": "samples/s", "dp": N, "tp": N, "pp": N,
    "baseline_dp_only_samples_s": N, "weights_match": true,
+   "resident": {"weight_bytes_per_device": N,
+                "stacked_weight_bytes_per_device": N,
+                "stash_bytes_per_device": N, ...},
+   "replicated": {...same keys...},
+   "residency_ratio": R,   # stacked bytes resident / replicated (~1/pp)
+   "overlap": {...mxnet_tpu.hlo.overlap_report of the fused step...},
    "sweep": [{"microbatches": M, "samples_s": N, "ms_per_step": N,
               "bubble_fraction": B, "ticks": T, "vs_dp_only": R}, ...]}
 
@@ -23,9 +31,19 @@ Methodology (PERF.md appendix "Pipeline parallelism"):
 - bubble_fraction: the schedule-table idle fraction, exactly
   (pp−1)/(M+pp−1) for the packed 1F1B/GPipe flush — the acceptance
   gate asserts < 1/M × (pp−1) × 1.25 at M=8.
-- weights_match: N fused steps of the pp run against the dp-only run
-  from identical init agree to 2e-4/2e-5 (fp reassociation of the
-  microbatch sum is the only permitted difference).
+- weights_match: N fused steps of the pp run (BOTH the stage-resident
+  and the replicated-weights path) against the dp-only run from
+  identical init agree to 2e-4/2e-5 (fp reassociation of the
+  microbatch sum is the only permitted difference) — the equivalence
+  gate the memory-pitfalls rule demands for any new sharding
+  constraint on this jaxlib.
+- weight_bytes_per_device: Module.param_bytes_per_device() — live
+  parameter storage per device.  stacked_weight_bytes_per_device
+  isolates the __pp_block__ trunk params; stage residency drops that
+  number ~1/pp (the gate asserts <= replicated/pp * 1.3).
+- stash_bytes_per_device: the compiled step's temp allocation
+  (Module.fused_memory_analysis().temp_size_in_bytes) — covers the
+  (S, M, ...) activation stash the pipeline carries.
 
 Env knobs: BENCH_PP_LAYERS (8), BENCH_PP_HIDDEN (256), BENCH_PP_BATCH
 (64), BENCH_PP_MICRO ("1,2,4,8"), BENCH_PP_PP (2), BENCH_PP_TP (1),
@@ -48,6 +66,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import hlo as mxhlo  # noqa: E402
 from mxnet_tpu import parallel, pp  # noqa: E402
 
 LAYERS = int(os.environ.get("BENCH_PP_LAYERS", "8"))
@@ -78,6 +97,32 @@ def _sym():
     return mx.sym.SoftmaxOutput(net, name="softmax")
 
 
+def _block_names(mod):
+    return [n for n in mod._grad_param_names if n.startswith("blk")]
+
+
+def _stacked_weight_bytes(mod):
+    """Per-device bytes of the __pp_block__ trunk params — slab shards
+    under residency, full replicated arrays otherwise."""
+    slabs = getattr(mod, "_pp_slabs", None)
+    total = 0
+    if slabs:
+        for slab in slabs:
+            shard = slab.sharding.shard_shape(tuple(slab.shape))
+            total += int(np.prod(shard, dtype=np.int64)
+                         * slab.dtype.itemsize)
+        return total
+    for n in _block_names(mod):
+        d = mod._exec.arg_dict[n]._data
+        sh = getattr(d, "sharding", None)
+        if sh is not None and hasattr(sh, "shard_shape"):
+            total += int(np.prod(sh.shard_shape(tuple(d.shape)),
+                                 dtype=np.int64) * d.dtype.itemsize)
+        else:
+            total += int(d.nbytes)
+    return total
+
+
 def _module(plan):
     mx.random.seed(11)
     mod = mx.mod.Module(_sym(), context=mx.cpu())
@@ -105,8 +150,12 @@ def _run_steps(mod, n, collect=False):
         mod.update()
     import jax
 
-    jax.block_until_ready(
-        [mod._exec.arg_dict[n_]._data for n_ in mod._grad_param_names])
+    # block on the step counter + outputs: under MXNET_PP_RESIDENT the
+    # per-name block-param buffers are freed (authority = the slabs),
+    # so arg_dict is not the thing to wait on
+    sync = [mod._fused_t] if mod._fused_t is not None else []
+    sync += [o._data for o in (mod._exec.outputs_cache or [])]
+    jax.block_until_ready(sync)
     if collect:
         args, _ = mod.get_params()
         return {k: np.asarray(mx.nd.gather_global(v))
@@ -123,6 +172,23 @@ def _bench(plan):
     return mod, dt
 
 
+def _memory_row(mod):
+    row = {
+        "weight_bytes_per_device": int(mod.param_bytes_per_device()),
+        "stacked_weight_bytes_per_device": int(_stacked_weight_bytes(mod)),
+        "resident": bool(getattr(mod, "_pp_resident", False)),
+    }
+    try:
+        ma = mod.fused_memory_analysis()
+        row["stash_bytes_per_device"] = int(ma.temp_size_in_bytes)
+        row["arg_bytes_per_device"] = int(ma.argument_size_in_bytes)
+    except Exception as e:  # noqa: BLE001 — evidence, not the gate
+        row["stash_bytes_per_device"] = None
+        print(f"note: memory analysis unavailable ({e})",
+              file=sys.stderr)
+    return row
+
+
 def main():
     import jax
 
@@ -134,13 +200,48 @@ def main():
     _, base_dt = _bench(base_plan)
     base_sps = BATCH / base_dt
 
-    # equivalence proof: pp weights == dp-only weights from same init
+    # equivalence proof from identical init: dp-only reference vs the
+    # pp run on BOTH weight placements (stage-resident is the default;
+    # the replicated path is the known-good anchor on this jaxlib)
     ref = _run_steps(_module(base_plan), 4, collect=True)
-    eq_plan = parallel.MeshPlan(jax.devices(), dp=dp, tp=TP, pp=PP,
-                                microbatches=max(2, PP), rules=RULES)
-    got = _run_steps(_module(eq_plan), 4, collect=True)
-    match = all(np.allclose(ref[k], got[k], rtol=2e-4, atol=2e-5)
-                for k in ref)
+
+    def eq_plan():
+        return parallel.MeshPlan(jax.devices(), dp=dp, tp=TP, pp=PP,
+                                 microbatches=max(2, PP), rules=RULES)
+
+    resident_env = os.environ.get("MXNET_PP_RESIDENT")  # sweep honors it
+    os.environ["MXNET_PP_RESIDENT"] = "0"
+    mod_rep = _module(eq_plan())
+    got_rep = _run_steps(mod_rep, 4, collect=True)
+    rep_row = _memory_row(mod_rep)
+    os.environ["MXNET_PP_RESIDENT"] = "1"
+    mod_res = _module(eq_plan())
+    # memory snapshot while the slabs are live (get_params would
+    # materialize them away), then the remaining equivalence steps
+    _run_steps(mod_res, 4)
+    res_row = _memory_row(mod_res)
+    overlap = {}
+    try:
+        overlap = mxhlo.overlap_report(mod_res.fused_hlo_text())
+    except Exception as e:  # noqa: BLE001
+        print(f"note: overlap inspection unavailable ({e})",
+              file=sys.stderr)
+    got_res = {k: np.asarray(v.asnumpy())
+               for k, v in mod_res.get_params()[0].items()}
+    match_rep = all(np.allclose(ref[k], got_rep[k], rtol=2e-4, atol=2e-5)
+                    for k in ref)
+    match_res = all(np.allclose(ref[k], got_res[k], rtol=2e-4, atol=2e-5)
+                    for k in ref)
+    match = match_rep and match_res
+    ratio = (res_row["stacked_weight_bytes_per_device"]
+             / max(rep_row["stacked_weight_bytes_per_device"], 1))
+
+    # the sweep runs whatever placement the caller asked for
+    # (MXNET_PP_RESIDENT, default = stage-resident)
+    if resident_env is None:
+        os.environ.pop("MXNET_PP_RESIDENT", None)
+    else:
+        os.environ["MXNET_PP_RESIDENT"] = resident_env
 
     sweep = []
     dropped = [m for m in MICRO if BATCH % (dp * m)]
@@ -174,11 +275,22 @@ def main():
         "schedule": os.environ.get("MXNET_PP_SCHEDULE", "1f1b"),
         "baseline_dp_only_samples_s": round(base_sps, 2),
         "weights_match": bool(match),
+        "weights_match_replicated": bool(match_rep),
+        "weights_match_resident": bool(match_res),
+        "resident": res_row,
+        "replicated": rep_row,
+        "residency_ratio": round(ratio, 4),
+        "overlap": overlap,
         "sweep": sweep,
     }
     print(json.dumps(out))
     if not match:
-        raise SystemExit("pp and dp-only training diverged")
+        raise SystemExit("pp and dp-only training diverged "
+                         f"(replicated={match_rep} resident={match_res})")
+    if PP > 1 and not ratio <= 1.0 / PP * 1.3:
+        raise SystemExit(
+            f"stage residency did not drop stacked weight bytes ~1/pp: "
+            f"ratio {ratio:.3f} vs bound {1.0 / PP * 1.3:.3f}")
     # every swept row is gated against its own bound — no silent skip
     # (pp=1 has no pipeline and a zero bubble by construction)
     bad = [r for r in sweep

@@ -433,6 +433,17 @@ def bench_transformer(layers=12, d_model=768, heads=12, T=1024, batch=8,
         np.take_along_axis(out, lab[..., None], axis=-1), 1e-12))))
     log(f"transformer warmup+compile {time.time()-t0:.1f}s")
 
+    # live step-time decomposition (the goodput tracker's accounting,
+    # PR 15): in-program collective time attributed from the compiled
+    # step's cost surface — 0 on this single-chip config, but the
+    # fractions are reported either way and must sum to 1
+    from mxnet_tpu import profiler as _prof
+
+    tracker = _prof.GoodputTracker(registry=_prof.MetricsRegistry())
+    comm_frac = mod.account_program_comm()
+    if comm_frac:
+        tracker.set_program_comm_fraction(comm_frac)
+
     windows, per_window, window_ms, done = 5, max(iters // 5, 1), [], 0
     for _ in range(windows):
         t0 = time.time()
@@ -440,7 +451,12 @@ def bench_transformer(layers=12, d_model=768, heads=12, T=1024, batch=8,
             mod.forward_backward(batches[(done + i) % n_batches])
             mod.update()
         mod.get_outputs()[0].wait_to_read()
-        window_ms.append((time.time() - t0) / per_window * 1000)
+        w_s = time.time() - t0
+        window_ms.append(w_s / per_window * 1000)
+        # one decomposition sample per timed window (async dispatch
+        # makes per-iteration walls meaningless; the window is the
+        # honest unit)
+        tracker.step(w_s)
         done += per_window
     out = np.asarray(mod.get_outputs()[0].asnumpy(), np.float32)
     lab = labels_np[(done - 1) % n_batches]
@@ -482,6 +498,10 @@ def bench_transformer(layers=12, d_model=768, heads=12, T=1024, batch=8,
         "mfu_device": mfu_dev,
         "loss_first": round(loss_first, 4),
         "loss_last": round(loss_last, 4),
+        "program_comm_fraction": comm_frac,
+        "decomposition": {
+            k: round(v, 4) for k, v in
+            tracker.summary().get("decomposition", {}).items()},
     }
 
 
