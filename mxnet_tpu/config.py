@@ -504,6 +504,39 @@ register_env(
     "Negative, garbage, or non-multiple-of-kv_block values raise at "
     "engine construction.")
 register_env(
+    "MXNET_SERVING_TP", 1, int,
+    "Tensor-parallel width of serving.DecodeEngine: 1 (default) is "
+    "the single-device engine; N > 1 AOT-compiles every prefill / "
+    "suffix-prefill / verify / decode executable against an N-way "
+    "'tp' mesh (shard_map) with attention heads, the fused QKV "
+    "projection, ff1, and the vocab head/embedding split exactly as "
+    "lm_partition_rules() declares, and KV pages + scale pages "
+    "sharded over heads — per-device pool bytes drop ~1/N, so "
+    "weights+pool bigger than one chip fit.  Decode output stays "
+    "bit-identical (fp32/lax) to tp=1: only output dims shard, "
+    "contractions are reconstructed with exact all-gathers, and "
+    "sampling is psum'd off the mesh so the (engine seed, stream "
+    "seed, position) contract survives.  Values < 1, garbage, or tp "
+    "not dividing num_heads raise at engine construction.")
+register_env(
+    "MXNET_SERVING_PP", 1, int,
+    "Pipeline-parallel depth of serving.DecodeEngine: 1 (default) "
+    "keeps all layers on every tp shard; S > 1 stacks the residual "
+    "blocks into S stage-resident slabs (dim-0 sharded over a 'pp' "
+    "mesh axis, the PR-15 layout) and runs decode as S ppermute "
+    "micro-hops inside one SPMD program, tokens psum'd off the last "
+    "stage.  Composes with MXNET_SERVING_TP (mesh is pp x tp; "
+    "tp*pp devices per engine).  Values < 1, garbage, or pp not "
+    "dividing num_layers raise at engine construction.")
+register_env(
+    "MXNET_SERVING_DEVICES", None, str,
+    "Comma-separated jax.devices() ordinals the DecodeEngine mesh "
+    "uses (e.g. '0,1,2,3'), length tp*pp.  Unset: the first tp*pp "
+    "devices.  fleet.spawn_replica(devices=...) exports this to each "
+    "replica child so one host packs several tp-sharded replicas on "
+    "disjoint device sets.  Out-of-range ordinals, duplicates, or a "
+    "length not equal to tp*pp raise at engine construction.")
+register_env(
     "MXNET_FLEET_REPLICAS", 2, int,
     "Replica-process count for fleet.launch_local_fleet / "
     "tools/bench_fleet.py when none is given explicitly.  Each replica "

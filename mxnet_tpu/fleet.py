@@ -63,7 +63,7 @@ import sys
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -610,16 +610,26 @@ def read_endpoint(fleet_dir: str, rid: int,
 
 def spawn_replica(rid: int, fleet_dir: str, builder: str,
                   builder_kwargs: Optional[Dict] = None,
-                  env: Optional[Dict[str, str]] = None) -> subprocess.Popen:
+                  env: Optional[Dict[str, str]] = None,
+                  devices: Optional[Sequence[int]] = None
+                  ) -> subprocess.Popen:
     """Start one replica process: ``python -m mxnet_tpu.fleet`` imports
     ``builder`` ("pkg.module:function"), calls it with
     ``builder_kwargs`` to construct the engine, wraps it in a
     ReplicaHarness, and serves until stopped (or until its parent
-    dies — replicas watch getppid, the io_pool orphan rule)."""
+    dies — replicas watch getppid, the io_pool orphan rule).
+
+    ``devices``: device ordinals this replica's engine meshes over —
+    exported as ``MXNET_SERVING_DEVICES`` so a model-parallel replica
+    (MXNET_SERVING_TP / MXNET_SERVING_PP > 1) binds its tp x pp slice
+    of the host's chips while its siblings bind theirs."""
     spec = {"rid": int(rid), "fleet_dir": fleet_dir, "builder": builder,
             "kwargs": builder_kwargs or {}, "parent": os.getpid()}
     child_env = dict(os.environ)
     child_env.update(env or {})
+    if devices is not None:
+        child_env["MXNET_SERVING_DEVICES"] = \
+            ",".join(str(int(d)) for d in devices)
     return subprocess.Popen(
         [sys.executable, "-m", "mxnet_tpu.fleet", json.dumps(spec)],
         env=child_env)

@@ -50,7 +50,7 @@ from .base import MXNetError
 
 __all__ = ["BlockAllocator", "blocks_for_tokens", "bucket_ladder",
            "trim_blocks", "kv_storage_dtype", "kv_quantized",
-           "KV_DTYPES", "KV_QMAX"]
+           "pool_device_bytes", "KV_DTYPES", "KV_QMAX"]
 
 SCRATCH_PAGE = 0
 
@@ -90,6 +90,26 @@ def kv_storage_dtype(name: str) -> np.dtype:
     raise MXNetError(
         f"unknown KV cache dtype {name!r} (MXNET_SERVING_KV_DTYPE "
         f"wants one of {KV_DTYPES})")
+
+
+def pool_device_bytes(cache_blocks: int, kv_block: int,
+                      num_layers: int, num_heads: int, d_model: int,
+                      kv_dtype: str = "fp32", tp: int = 1,
+                      pp: int = 1) -> int:
+    """Bytes of K/V pool (values + quantization scales) EACH device
+    holds for a serving engine meshed ``tp x pp``: the stacked layer
+    dim shards over 'pp' (stage-resident slabs) and the head dim over
+    'tp', so per-device bytes fall as 1/(tp*pp).  ``tp=pp=1`` is the
+    single-device total — capacity planners (and bench_serving's
+    --tp sizing) compare the two to prove a model's pool doesn't fit
+    one chip."""
+    d_head = int(d_model) // int(num_heads)
+    slots = int(num_layers) * int(cache_blocks) * int(kv_block) \
+        * int(num_heads)
+    total = 2 * slots * d_head * kv_storage_dtype(kv_dtype).itemsize
+    if kv_quantized(kv_dtype):
+        total += 2 * slots * 4  # per-slot-per-head float32 scales
+    return total // (int(tp) * int(pp))
 
 
 def blocks_for_tokens(tokens: int, block_tokens: int) -> int:

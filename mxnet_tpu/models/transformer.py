@@ -159,6 +159,12 @@ def get_symbol(vocab_size=10000, seq_len=128, num_layers=4, num_heads=4,
 # (or a Predictor) bind without renaming.  Both symbols take a
 # ``positions`` (B, S) int input instead of assuming rows 0..S-1, so
 # ONE symbol serves every (batch, length) bucket the engine compiles.
+#
+# Like the training symbols, every decode weight (and KV pool) carries
+# LOGICAL axis names, so the SAME :func:`lm_partition_rules` table that
+# shards training drives the serving engine's MeshPlan
+# (``serving_mesh.py``): 'qkv'/'ffn'/'vocab' weight rows and the pools'
+# 'heads' dim resolve to 'tp', everything else replicates.
 # ---------------------------------------------------------------------------
 
 
@@ -166,19 +172,46 @@ def _decode_block(x, d_model, num_heads, d_ff, name, kv_block, attend):
     """One pre-LN transformer block with the attention sublayer
     replaced by ``attend(qkv) -> (att_out, *cache_outs)``."""
     h = sym.LayerNorm(x, name=f"{name}_ln1")
-    qkv = sym.FullyConnected(h, num_hidden=3 * d_model, flatten=False,
-                             name=f"{name}_qkv")
+    qkv = sym.FullyConnected(
+        h, num_hidden=3 * d_model, flatten=False, name=f"{name}_qkv",
+        weight=sym.Variable(f"{name}_qkv_weight",
+                            attr=logical_axes("qkv", "embed")),
+        bias=sym.Variable(f"{name}_qkv_bias", attr=logical_axes("qkv")))
     att, cache_outs = attend(qkv)
-    att = sym.FullyConnected(att, num_hidden=d_model, flatten=False,
-                             name=f"{name}_proj")
+    att = sym.FullyConnected(
+        att, num_hidden=d_model, flatten=False, name=f"{name}_proj",
+        weight=sym.Variable(f"{name}_proj_weight",
+                            attr=logical_axes("embed", "heads")),
+        bias=sym.Variable(f"{name}_proj_bias",
+                          attr=logical_axes("embed")))
     x = x + att
     h = sym.LayerNorm(x, name=f"{name}_ln2")
-    h = sym.FullyConnected(h, num_hidden=d_ff, flatten=False,
-                           name=f"{name}_ff1")
+    h = sym.FullyConnected(
+        h, num_hidden=d_ff, flatten=False, name=f"{name}_ff1",
+        weight=sym.Variable(f"{name}_ff1_weight",
+                            attr=logical_axes("ffn", "embed")),
+        bias=sym.Variable(f"{name}_ff1_bias", attr=logical_axes("ffn")))
     h = sym.Activation(h, act_type="gelu", name=f"{name}_gelu")
-    h = sym.FullyConnected(h, num_hidden=d_model, flatten=False,
-                           name=f"{name}_ff2")
+    h = sym.FullyConnected(
+        h, num_hidden=d_model, flatten=False, name=f"{name}_ff2",
+        weight=sym.Variable(f"{name}_ff2_weight",
+                            attr=logical_axes("embed", "ffn")),
+        bias=sym.Variable(f"{name}_ff2_bias", attr=logical_axes("embed")))
     return x + h, cache_outs
+
+
+def kv_pool_var(name: str):
+    """A KV value-pool Variable (P, KVB, H, D): the 'heads' dim is the
+    pool's tensor-parallel shard axis (the rules table maps it to
+    'tp', splitting pages head-wise exactly like the attention)."""
+    return sym.Variable(name, attr=logical_axes(None, None, "heads",
+                                                None))
+
+
+def kv_scale_var(name: str):
+    """A quantized pool's (P, KVB, H) float32 scale Variable — sharded
+    head-wise alongside the values it scales."""
+    return sym.Variable(name, attr=logical_axes(None, None, "heads"))
 
 
 def _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block, attend_for,
@@ -189,8 +222,12 @@ def _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block, attend_for,
     data = sym.Variable("data")            # (B, S) token ids
     positions = sym.Variable("positions")  # (B, S) absolute positions
     x = sym.Embedding(data, input_dim=vocab_size, output_dim=d_model,
-                      name="tok_embed")
-    pos = sym.Variable("pos_embed_weight")
+                      name="tok_embed",
+                      weight=sym.Variable(
+                          "tok_embed_weight",
+                          attr=logical_axes("vocab", "embed")))
+    pos = sym.Variable("pos_embed_weight",
+                       attr=logical_axes("length", "embed"))
     x = x + sym.take(pos, positions, name="pos_lookup")
     caches = []
     for i in range(num_layers):
@@ -199,8 +236,11 @@ def _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block, attend_for,
                                       attend_for(i))
         caches.extend(cache_outs)
     x = sym.LayerNorm(x, name="ln_f")
-    logits = sym.FullyConnected(x, num_hidden=vocab_size, flatten=False,
-                                name="head")
+    logits = sym.FullyConnected(
+        x, num_hidden=vocab_size, flatten=False, name="head",
+        weight=sym.Variable("head_weight",
+                            attr=logical_axes("vocab", "embed")),
+        bias=sym.Variable("head_bias", attr=logical_axes("vocab")))
     return sym.Group([logits] + caches)
 
 
@@ -244,16 +284,16 @@ def transformer_lm_prefill(vocab_size, num_layers=4, num_heads=4,
                 return out, [k, v]
             if quant:
                 pools = sym.PagedCacheWriteQ(
-                    k, v, sym.Variable(f"layer{i}_kpool"),
-                    sym.Variable(f"layer{i}_vpool"),
-                    sym.Variable(f"layer{i}_kscale"),
-                    sym.Variable(f"layer{i}_vscale"),
+                    k, v, kv_pool_var(f"layer{i}_kpool"),
+                    kv_pool_var(f"layer{i}_vpool"),
+                    kv_scale_var(f"layer{i}_kscale"),
+                    kv_scale_var(f"layer{i}_vscale"),
                     sym.Variable("block_table"), lengths,
                     name=f"layer{i}_cache_write")
                 return out, [pools[0], pools[1], pools[2], pools[3]]
             pools = sym.PagedCacheWrite(
-                k, v, sym.Variable(f"layer{i}_kpool"),
-                sym.Variable(f"layer{i}_vpool"),
+                k, v, kv_pool_var(f"layer{i}_kpool"),
+                kv_pool_var(f"layer{i}_vpool"),
                 sym.Variable("block_table"), lengths,
                 name=f"layer{i}_cache_write")
             return out, [pools[0], pools[1]]
@@ -287,16 +327,16 @@ def transformer_lm_prefix_prefill(vocab_size, num_layers=4, num_heads=4,
         def attend(qkv):
             if quant:
                 att = sym.QKVPagedPrefillAttendQ(
-                    qkv, sym.Variable(f"layer{i}_kpool"),
-                    sym.Variable(f"layer{i}_vpool"),
-                    sym.Variable(f"layer{i}_kscale"),
-                    sym.Variable(f"layer{i}_vscale"),
+                    qkv, kv_pool_var(f"layer{i}_kpool"),
+                    kv_pool_var(f"layer{i}_vpool"),
+                    kv_scale_var(f"layer{i}_kscale"),
+                    kv_scale_var(f"layer{i}_vscale"),
                     sym.Variable("block_table"), start, lengths,
                     num_heads=num_heads, name=f"layer{i}_attn")
                 return att[0], [att[1], att[2], att[3], att[4]]
             att = sym.QKVPagedPrefillAttend(
-                qkv, sym.Variable(f"layer{i}_kpool"),
-                sym.Variable(f"layer{i}_vpool"),
+                qkv, kv_pool_var(f"layer{i}_kpool"),
+                kv_pool_var(f"layer{i}_vpool"),
                 sym.Variable("block_table"), start, lengths,
                 num_heads=num_heads, name=f"layer{i}_attn")
             return att[0], [att[1], att[2]]
@@ -331,16 +371,16 @@ def transformer_lm_verify(vocab_size, num_layers=4, num_heads=4,
         def attend(qkv):
             if quant:
                 att = sym.QKVPagedVerifyAttendQ(
-                    qkv, sym.Variable(f"layer{i}_kpool"),
-                    sym.Variable(f"layer{i}_vpool"),
-                    sym.Variable(f"layer{i}_kscale"),
-                    sym.Variable(f"layer{i}_vscale"),
+                    qkv, kv_pool_var(f"layer{i}_kpool"),
+                    kv_pool_var(f"layer{i}_vpool"),
+                    kv_scale_var(f"layer{i}_kscale"),
+                    kv_scale_var(f"layer{i}_vscale"),
                     sym.Variable("block_table"), start, lengths,
                     num_heads=num_heads, name=f"layer{i}_attn")
                 return att[0], [att[1], att[2], att[3], att[4]]
             att = sym.QKVPagedVerifyAttend(
-                qkv, sym.Variable(f"layer{i}_kpool"),
-                sym.Variable(f"layer{i}_vpool"),
+                qkv, kv_pool_var(f"layer{i}_kpool"),
+                kv_pool_var(f"layer{i}_vpool"),
                 sym.Variable("block_table"), start, lengths,
                 num_heads=num_heads, name=f"layer{i}_attn")
             return att[0], [att[1], att[2]]
@@ -372,17 +412,17 @@ def transformer_lm_decode(vocab_size, num_layers=4, num_heads=4,
         def attend(qkv):
             if paged and quant:
                 att = sym.QKVPagedAttentionDecodeQ(
-                    qkv, sym.Variable(f"layer{i}_kpool"),
-                    sym.Variable(f"layer{i}_vpool"),
-                    sym.Variable(f"layer{i}_kscale"),
-                    sym.Variable(f"layer{i}_vscale"),
+                    qkv, kv_pool_var(f"layer{i}_kpool"),
+                    kv_pool_var(f"layer{i}_vpool"),
+                    kv_scale_var(f"layer{i}_kscale"),
+                    kv_scale_var(f"layer{i}_vscale"),
                     sym.Variable("block_table"), lengths,
                     num_heads=num_heads, name=f"layer{i}_attn")
                 return att[0], [att[1], att[2], att[3], att[4]]
             elif paged:
                 att = sym.QKVPagedAttentionDecode(
-                    qkv, sym.Variable(f"layer{i}_kpool"),
-                    sym.Variable(f"layer{i}_vpool"),
+                    qkv, kv_pool_var(f"layer{i}_kpool"),
+                    kv_pool_var(f"layer{i}_vpool"),
                     sym.Variable("block_table"), lengths,
                     num_heads=num_heads, name=f"layer{i}_attn")
             else:

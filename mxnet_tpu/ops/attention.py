@@ -6,6 +6,18 @@ fused multi-head scaled-dot-product attention usable from symbols and
 imperatively, with a blockwise (FlashAttention-style) formulation that
 never materializes the full (T, T) score matrix — the building block
 ``mxnet_tpu.sequence`` distributes over the mesh (ring / Ulysses).
+
+Mesh contract (serving_mesh.MeshPrograms runs these INSIDE shard_map):
+every paged op here is head-wise independent — scores, softmax and
+the weighted sum never mix heads — so calling it on a tp shard's
+LOCAL head slice (num_heads = H/tp, pools sliced on their head dim)
+computes exactly the rows a single-device call computes for those
+heads; page gathers/scatters through the block table are pure data
+movement, bit-exact under sharding.  The one subtlety is the scratch
+page: padding rows all scatter to (page 0, slot 0) and the winning
+duplicate is implementation-defined, but it is CONSISTENT between two
+jitted programs built from the same ops, which is what the engine's
+bit-replay contract needs (page 0 is never read unmasked).
 """
 
 from __future__ import annotations

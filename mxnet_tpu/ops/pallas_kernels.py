@@ -1393,7 +1393,13 @@ def paged_attention_decode(q, k_pool, v_pool, block_table, lengths):
 
     Grid (B, MB): each step DMAs ONE page of K and V into VMEM via the
     scalar-prefetched block table and folds it into the per-head
-    online-softmax state held in VMEM scratch."""
+    online-softmax state held in VMEM scratch.
+
+    H here is whatever the caller holds — under the serving mesh's
+    shard_map it is the LOCAL head count H/tp with pools sliced on
+    their head dim, and the kernel is head-wise independent, so the
+    grid/DMA structure (and per-step VMEM footprint) just shrinks
+    with the shard."""
     B, H, D = q.shape
     P, KVB = k_pool.shape[0], k_pool.shape[1]
     MB = block_table.shape[1]

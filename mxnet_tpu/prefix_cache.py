@@ -40,7 +40,11 @@ This module is pure host-side bookkeeping (dict/tree arithmetic, no
 jax): :class:`mxnet_tpu.serving.DecodeEngine` drives it at admission
 (attach + suffix-only prefill), at each decode step (the COW probe),
 at preemption/retire (release), and inside allocation (evict-on-
-pressure).  Counters: ``serving.prefix_hits`` /
+pressure).  Page ids, refcounts and the radix index are HOST-GLOBAL
+and mesh-invariant: under a tp x pp serving mesh every device holds
+the same page GRID (its shard of each page's head/layer slice), so
+one block-table splice, one COW copy, one eviction decision applies
+to all shards at once — nothing here learns about the mesh.  Counters: ``serving.prefix_hits`` /
 ``serving.prefix_hit_tokens`` / ``serving.cow_copies`` /
 ``serving.evictions``; the ``serving.shared_blocks`` gauge lives with
 the allocator.

@@ -68,15 +68,23 @@ def sequence_mesh(sp: Optional[int] = None, devices=None,
     return Mesh(np.asarray(devices[:sp]), (axis_name,))
 
 
-def _ring_attention_local(q, k, v, axis_name, causal, block_size):
-    """shard_map body: q/k/v are the local (B, T/sp, H, D) shards."""
+def _ring_attention_local(q, k, v, axis_name, causal, block_size,
+                          q_offset):
+    """shard_map body: q is the local (B, Tq/sp, H, D) shard, k/v the
+    local (B, Tkv/sp, H, D) shards.  ``q_offset`` is the absolute K/V
+    position of the GLOBAL q[0] — 0 for the classic self-attention
+    layout (Tq == Tkv), the chunk start for the decode-time layout
+    where q is one prefill chunk and k/v are the K/V gathered from the
+    cache over everything written so far."""
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
-    t_local = q.shape[1]
+    t_q = q.shape[1]
+    t_kv = k.shape[1]
+    q_start = q_offset + idx * t_q  # absolute position of local q[0]
     perm = [(i, (i + 1) % sp) for i in range(sp)]  # ring: send right
 
     def partial_for(k_cur, v_cur, src):
-        kv_off = (src - idx) * t_local  # k_abs_start - q_abs_start
+        kv_off = src * t_kv - q_start  # k_abs_start - q_abs_start
         return blockwise_attention_partial(
             q, k_cur, v_cur, causal=causal, block_size=block_size,
             kv_offset=kv_off)
@@ -93,10 +101,12 @@ def _ring_attention_local(q, k, v, axis_name, causal, block_size):
         v_cur = lax.ppermute(v_cur, axis_name, perm)
         src = (idx - j) % sp
         if causal:
-            # a strictly-future shard contributes nothing under the
-            # causal mask — skip its whole attention compute
+            # a shard whose first key is past this shard's LAST query
+            # contributes nothing under the causal mask — skip its
+            # whole attention compute (the q_offset shift keeps the
+            # skip exact for the chunked decode-time layout too)
             o, m, l = lax.cond(
-                src > idx,
+                src * t_kv > q_start + t_q - 1,
                 lambda s, kc, vc, sr: s,
                 lambda s, kc, vc, sr: merge_hop(s, kc, vc, sr),
                 (o, m, l), k_cur, v_cur, src)
@@ -113,13 +123,25 @@ def _ring_attention_local(q, k, v, axis_name, causal, block_size):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
-                   causal: bool = False, block_size: int = 512):
+                   causal: bool = False, block_size: int = 512,
+                   q_offset=0):
     """Sequence-parallel attention: (B, T, H, D) global arrays with T
-    sharded over ``axis_name``; returns same-sharded output."""
+    sharded over ``axis_name``; returns same-sharded output.
+
+    ``q_offset`` unlocks the decode-time K/V-gathered layout: q may be
+    SHORTER than k/v (one chunk of a long prompt, Tq != Tkv) with its
+    rows sitting at absolute K/V positions ``[q_offset, q_offset+Tq)``
+    — the shape the chunked-prefill state machine feeds when a prompt
+    outgrows one chip's prefill ladder (suffix chunk attends the whole
+    gathered history).  Both T axes shard over ``axis_name``; causal
+    masking and the future-shard skip shift by ``q_offset`` so the
+    result is bit-identical to the same chunk's rows of a full causal
+    forward."""
     spec = P(None, axis_name, None, None)
     fn = _shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, block_size=block_size),
+                          causal=causal, block_size=block_size,
+                          q_offset=q_offset),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         # the Pallas flash kernel's interpret-mode lowering (CPU tests)
         # mixes sp-varying operands with unvarying grid indices in its
@@ -130,7 +152,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     return fn(q, k, v)
 
 
-def _ulysses_local(q, k, v, axis_name, causal, block_size):
+def _ulysses_local(q, k, v, axis_name, causal, block_size, q_offset):
     """a2a: (B, T/sp, H, D) → (B, T, H/sp, D), attend, a2a back."""
     sp = lax.psum(1, axis_name)
     H = q.shape[2]
@@ -147,22 +169,36 @@ def _ulysses_local(q, k, v, axis_name, causal, block_size):
                               tiled=True)
 
     qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    # full (non-ring) attention after the a2a: the normalized flash
-    # kernel (in-kernel normalization + Pallas backward) — faster than
-    # partial+normalize with the lax-remat backward
-    out = blockwise_attention(qf, kf, vf, causal=causal,
-                              block_size=block_size)
+    plain = isinstance(q_offset, int) and q_offset == 0 \
+        and q.shape[1] == k.shape[1]
+    if plain:
+        # full (non-ring) attention after the a2a: the normalized flash
+        # kernel (in-kernel normalization + Pallas backward) — faster
+        # than partial+normalize with the lax-remat backward
+        out = blockwise_attention(qf, kf, vf, causal=causal,
+                                  block_size=block_size)
+    else:
+        # decode-time layout (q is a chunk at q_offset into the K/V
+        # timeline): kv_offset = k_abs_start - q_abs_start = -q_offset
+        o, m, l = blockwise_attention_partial(
+            qf, kf, vf, causal=causal, block_size=block_size or 512,
+            kv_offset=-q_offset)
+        out = normalize_attention_state(o, m, l, qf.dtype)
     return heads_to_seq(out)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
-                      causal: bool = False, block_size: int = 512):
+                      causal: bool = False, block_size: int = 512,
+                      q_offset=0):
     """All-to-all sequence parallelism (Ulysses): T sharded in/out,
-    heads sharded during the attention itself."""
+    heads sharded during the attention itself.  ``q_offset`` as in
+    :func:`ring_attention` — the decode-time K/V-gathered layout with
+    a chunked q (Tq != Tkv) at absolute offset ``q_offset``."""
     spec = P(None, axis_name, None, None)
     fn = _shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name,
-                          causal=causal, block_size=block_size),
+                          causal=causal, block_size=block_size,
+                          q_offset=q_offset),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check=not (_pk.enabled() and _pk._interpret()))
     return fn(q, k, v)

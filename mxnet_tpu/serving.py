@@ -33,6 +33,7 @@ the batch axis) do not mix rows.
 
 from __future__ import annotations
 
+import os
 import queue as _queue
 import threading
 import time
@@ -828,6 +829,27 @@ class InferenceEngine:
 # ---------------------------------------------------------------------------
 
 
+def sample_tokens(base_key, logits, temps, seeds, steps):
+    """On-device greedy/temperature sampling, per-stream keyed by
+    (engine seed, stream seed, absolute position) — reproducible
+    whatever batch the stream happens to ride in.  Module-level so the
+    mesh step programs (``serving_mesh``) run the EXACT sampler the
+    single-device engine runs: the fleet's decode-retry bit-replay
+    holds across tp/pp shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def one(sd, st, row, tp):
+        key = jax.random.fold_in(jax.random.fold_in(base_key, sd), st)
+        safe = jnp.where(tp > 0, tp, 1.0)
+        return jax.random.categorical(key, row / safe).astype(jnp.int32)
+
+    sampled = jax.vmap(one)(seeds, steps, logits, temps)
+    return jnp.where(temps > 0, sampled, greedy)
+
+
 def _read_env_int(name, lo=1):
     """Loud at-construction validation (the checkpoint env-var
     convention): garbage values raise immediately, naming the
@@ -992,7 +1014,7 @@ class DecodeEngine:
                  eos_id=None, ctx=None, donate=None, dtype="float32",
                  kv_dtype=None, prefix_cache=None, evict_policy=None,
                  spec_tokens=None, proposer=None, prefill_chunk=None,
-                 prewarm=False):
+                 tp=None, pp=None, devices=None, prewarm=False):
         import jax
 
         from .kv_cache import (BlockAllocator, blocks_for_tokens,
@@ -1087,12 +1109,97 @@ class DecodeEngine:
                 f"max_streams {self._max_streams} must be >= 1")
         self._max_streams = int(self._max_streams)
 
-        # -- parameters onto the device ---------------------------------
+        # -- model-parallel mesh (tp x pp) ------------------------------
+        # loud at-construction validation, the MXNET_CKPT_* pattern:
+        # a bad MXNET_SERVING_TP / MXNET_SERVING_PP / MXNET_SERVING_
+        # DEVICES raises HERE, not three minutes into a warmup
+        self._tp = int(tp) if tp is not None else \
+            _read_env_int("MXNET_SERVING_TP")
+        self._pp = int(pp) if pp is not None else \
+            _read_env_int("MXNET_SERVING_PP")
+        if self._tp < 1:
+            raise MXNetError(
+                f"MXNET_SERVING_TP={self._tp} must be >= 1")
+        if self._pp < 1:
+            raise MXNetError(
+                f"MXNET_SERVING_PP={self._pp} must be >= 1")
+        if self._H % self._tp:
+            raise MXNetError(
+                f"MXNET_SERVING_TP={self._tp} does not divide "
+                f"num_heads {self._H} — attention heads shard over "
+                f"'tp' whole")
+        if self._L % self._pp:
+            raise MXNetError(
+                f"MXNET_SERVING_PP={self._pp} does not divide "
+                f"num_layers {self._L} — pipeline stages hold equal "
+                f"layer slabs")
+        n_mesh = self._tp * self._pp
+        if devices is None:
+            devices = os.environ.get("MXNET_SERVING_DEVICES") or None
+        if isinstance(devices, str):
+            try:
+                devices = [int(t) for t in devices.split(",")
+                           if t.strip()]
+            except ValueError:
+                raise MXNetError(
+                    f"MXNET_SERVING_DEVICES={devices!r} must be a "
+                    f"comma-separated list of device ordinals")
+        mesh_devs = None
+        if devices is not None:
+            ords = [int(d) for d in devices]
+            all_devs = jax.devices()
+            if len(ords) != n_mesh:
+                raise MXNetError(
+                    f"MXNET_SERVING_DEVICES lists {len(ords)} devices "
+                    f"but the tp={self._tp} x pp={self._pp} mesh "
+                    f"needs {n_mesh}")
+            if len(set(ords)) != len(ords):
+                raise MXNetError(
+                    f"MXNET_SERVING_DEVICES={ords} repeats a device — "
+                    f"each mesh slot needs its own chip")
+            bad = [o for o in ords if o < 0 or o >= len(all_devs)]
+            if bad:
+                raise MXNetError(
+                    f"MXNET_SERVING_DEVICES ordinals {bad} out of "
+                    f"range — jax reports {len(all_devs)} devices")
+            mesh_devs = [all_devs[o] for o in ords]
+        elif n_mesh > 1:
+            all_devs = jax.devices()
+            if len(all_devs) < n_mesh:
+                raise MXNetError(
+                    f"tp={self._tp} x pp={self._pp} needs {n_mesh} "
+                    f"devices; jax reports {len(all_devs)}")
+            mesh_devs = list(all_devs[:n_mesh])
+
+        # -- parameters onto the device / mesh --------------------------
         if ctx is None:
             from .context import current_context
             ctx = current_context()
         self._ctx = ctx
-        dev = ctx.jax_device()
+        # pool STORAGE dtype: the legacy ``dtype`` arg for fp32 (it
+        # always meant the pool dtype), the kv_dtype mapping otherwise
+        self._np_dtype = np.dtype(dtype) if self._kv_dtype == "fp32" \
+            else kv_store_dtype
+        self._mesh = None
+        if n_mesh > 1:
+            from .models.transformer import lm_partition_rules
+            from .parallel import MeshPlan
+            from .serving_mesh import MeshPrograms
+            self._mesh = MeshPrograms(
+                MeshPlan(mesh_devs, dp=1, tp=self._tp, pp=self._pp,
+                         rules=lm_partition_rules()),
+                num_layers=self._L, num_heads=self._H,
+                d_model=int(d_model), d_ff=d_ff,
+                vocab_size=self._vocab, kv_block=self._kv_block,
+                kv_dtype=self._kv_dtype, pool_dtype=self._np_dtype,
+                seed=int(seed))
+            # every feed lands replicated; pools/params carry their
+            # own NamedShardings
+            dev = self._mesh.replicated
+        elif mesh_devs is not None:
+            dev = mesh_devs[0]
+        else:
+            dev = ctx.jax_device()
         self._device = dev
 
         def to_dev(v):
@@ -1197,27 +1304,32 @@ class DecodeEngine:
         if missing:
             raise MXNetError(f"params missing {missing} for the "
                              f"decode graph")
-        self._params = {n: to_dev(host_params[n])
-                        for n in self._param_names}
-        # pool STORAGE dtype: the legacy ``dtype`` arg for fp32 (it
-        # always meant the pool dtype), the kv_dtype mapping otherwise
-        self._np_dtype = np.dtype(dtype) if self._kv_dtype == "fp32" \
-            else kv_store_dtype
+        if self._mesh is not None:
+            # rules-resolved placement (tp output-dim shards, qkv rows
+            # head-permuted, replicated sampler base_key rides along)
+            self._params = self._mesh.shard_params(host_params)
+        else:
+            self._params = {n: to_dev(host_params[n])
+                            for n in self._param_names}
         # per-layer pool stride in self._pools: [k, v] or, quantized,
-        # [k, v, k_scale, v_scale]
+        # [k, v, k_scale, v_scale]; on a mesh the pools are STACKED
+        # (L, pages, ...) slabs instead, sharded pp x tp
         self._pool_stride = 4 if self._quant else 2
-        pool_shape = (int(cache_blocks), self._kv_block, self._H,
-                      self._D)
-        pool_zero = np.zeros(pool_shape, self._np_dtype)
-        scale_one = np.ones(pool_shape[:3], np.float32)
-        pools = []
-        for _ in range(self._L):
-            pools.append(jax.device_put(pool_zero, dev))
-            pools.append(jax.device_put(pool_zero, dev))
-            if self._quant:
-                pools.append(jax.device_put(scale_one, dev))
-                pools.append(jax.device_put(scale_one, dev))
-        self._pools = tuple(pools)
+        if self._mesh is not None:
+            self._pools = self._mesh.init_pools(int(cache_blocks))
+        else:
+            pool_shape = (int(cache_blocks), self._kv_block, self._H,
+                          self._D)
+            pool_zero = np.zeros(pool_shape, self._np_dtype)
+            scale_one = np.ones(pool_shape[:3], np.float32)
+            pools = []
+            for _ in range(self._L):
+                pools.append(jax.device_put(pool_zero, dev))
+                pools.append(jax.device_put(pool_zero, dev))
+                if self._quant:
+                    pools.append(jax.device_put(scale_one, dev))
+                    pools.append(jax.device_put(scale_one, dev))
+            self._pools = tuple(pools)
         self._pool_bytes = sum(int(np.prod(np.shape(p)))
                                * np.dtype(p.dtype).itemsize
                                for p in self._pools)
@@ -1373,6 +1485,22 @@ class DecodeEngine:
         missing = [n for n in self._param_names if n not in host]
         if missing:
             raise MXNetError(f"swap_params: params missing {missing}")
+        if self._mesh is not None:
+            clean = {}
+            for n in self._param_names:
+                v = host[n]
+                arr = np.asarray(v.asnumpy() if hasattr(v, "asnumpy")
+                                 else v)
+                want = self._mesh.host_shape(n)
+                if want is not None and tuple(arr.shape) != want:
+                    raise MXNetError(
+                        f"swap_params: param {n!r} shape {arr.shape} "
+                        f"!= serving shape {want}")
+                clean[n] = arr
+            # re-shards through the rules table (qkv head permutation
+            # included) — still one atomic reference swap
+            self._params = self._mesh.shard_params(clean)
+            return
         new = {}
         for n in self._param_names:
             v = host[n]
@@ -1389,6 +1517,9 @@ class DecodeEngine:
     def get_params(self):
         """Host snapshot of the served weights — the rollback anchor a
         failed swap restores from."""
+        if self._mesh is not None:
+            # checkpoint layout (qkv rows un-permuted, shards gathered)
+            return self._mesh.unshard_params(self._params)
         return {n: np.asarray(v) for n, v in self._params.items()}
 
     def generate(self, prompt, max_new_tokens=32, **kw) -> np.ndarray:
@@ -1480,6 +1611,14 @@ class DecodeEngine:
             out["active_streams"] = len(self._active)
             out["pending"] = len(self._pending)
         out["compiles"] = {str(k): v for k, v in self.compiles.items()}
+        # mesh shape + per-device pool bytes: what fleet_top / statusz
+        # show for a sharded replica (tp=pp=1 reads honestly too)
+        out["mesh"] = self._mesh.describe() if self._mesh is not None \
+            else {"tp": 1, "pp": 1, "devices": [str(self._device)],
+                  "sharded": {}}
+        out["pool_bytes_per_device"] = \
+            self._mesh.pool_bytes_per_device(self._pools) \
+            if self._mesh is not None else self._pool_bytes
         out["decode_buckets"] = list(self._decode_buckets)
         out["cache_buckets"] = list(self._cache_buckets)
         out["prefill_buckets"] = list(self._prefill_buckets)
@@ -1559,29 +1698,32 @@ class DecodeEngine:
         raise MXNetError(f"{what} {n} exceeds ladder {ladder}")
 
     def _sample(self, logits, temps, seeds, steps):
-        """On-device greedy/temperature sampling, per-stream keyed by
-        (engine seed, stream seed, absolute position) — reproducible
-        whatever batch the stream happens to ride in."""
-        import jax
-        import jax.numpy as jnp
-
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        base = self._base_key
-
-        def one(sd, st, row, tp):
-            key = jax.random.fold_in(jax.random.fold_in(base, sd), st)
-            safe = jnp.where(tp > 0, tp, 1.0)
-            return jax.random.categorical(key, row / safe).astype(
-                jnp.int32)
-
-        sampled = jax.vmap(one)(seeds, steps, logits, temps)
-        return jnp.where(temps > 0, sampled, greedy)
+        return sample_tokens(self._base_key, logits, temps, seeds,
+                             steps)
 
     def _spec_of(self, tree):
+        """AOT input specs for a params/pools pytree — on a mesh the
+        spec carries each leaf's NamedSharding so the lowered
+        executable bakes the shard_map placement in."""
         import jax
 
-        return jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(np.shape(a), a.dtype), tree)
+        def one(a):
+            if self._mesh is not None:
+                return jax.ShapeDtypeStruct(np.shape(a), a.dtype,
+                                            sharding=a.sharding)
+            return jax.ShapeDtypeStruct(np.shape(a), a.dtype)
+
+        return jax.tree_util.tree_map(one, tree)
+
+    def _arg_spec(self, shape, dtype):
+        """Spec of one scheduler feed (tokens/table/temps/...): small
+        host arrays, replicated across the mesh when one exists."""
+        import jax
+
+        if self._mesh is not None:
+            return jax.ShapeDtypeStruct(shape, dtype,
+                                        sharding=self._device)
+        return jax.ShapeDtypeStruct(shape, dtype)
 
     def _decode_exe(self, bb: int, mb: int):
         key = ("decode", bb, mb)
@@ -1608,15 +1750,18 @@ class DecodeEngine:
                                     steps)
                 return toks, tuple(outs[1:])
 
+            if self._mesh is not None:
+                step = self._mesh.decode_step()
+
             i32 = np.dtype(np.int32)
             specs = (self._spec_of(self._params),
-                     jax.ShapeDtypeStruct((bb, 1), i32),
-                     jax.ShapeDtypeStruct((bb, 1), i32),
-                     jax.ShapeDtypeStruct((bb,), i32),
-                     jax.ShapeDtypeStruct((bb, mb), i32),
-                     jax.ShapeDtypeStruct((bb,), np.dtype(np.float32)),
-                     jax.ShapeDtypeStruct((bb,), i32),
-                     jax.ShapeDtypeStruct((bb,), i32),
+                     self._arg_spec((bb, 1), i32),
+                     self._arg_spec((bb, 1), i32),
+                     self._arg_spec((bb,), i32),
+                     self._arg_spec((bb, mb), i32),
+                     self._arg_spec((bb,), np.dtype(np.float32)),
+                     self._arg_spec((bb,), i32),
+                     self._arg_spec((bb,), i32),
                      self._spec_of(self._pools))
             with profiler.scope(f"serving.compile.decode.b{bb}x{mb}",
                                 "serving", args={"batch": bb,
@@ -1665,16 +1810,19 @@ class DecodeEngine:
                                      steps0)
                 return emit, tuple(outs[1:])
 
+            if self._mesh is not None:
+                step = self._mesh.verify_step()
+
             i32 = np.dtype(np.int32)
             specs = (self._spec_of(self._params),
-                     jax.ShapeDtypeStruct((bb, W), i32),
-                     jax.ShapeDtypeStruct((bb, W), i32),
-                     jax.ShapeDtypeStruct((bb,), i32),
-                     jax.ShapeDtypeStruct((bb,), i32),
-                     jax.ShapeDtypeStruct((bb, mb), i32),
-                     jax.ShapeDtypeStruct((bb,), np.dtype(np.float32)),
-                     jax.ShapeDtypeStruct((bb,), i32),
-                     jax.ShapeDtypeStruct((bb,), i32),
+                     self._arg_spec((bb, W), i32),
+                     self._arg_spec((bb, W), i32),
+                     self._arg_spec((bb,), i32),
+                     self._arg_spec((bb,), i32),
+                     self._arg_spec((bb, mb), i32),
+                     self._arg_spec((bb,), np.dtype(np.float32)),
+                     self._arg_spec((bb,), i32),
+                     self._arg_spec((bb,), i32),
                      self._spec_of(self._pools))
             with profiler.scope(
                     f"serving.compile.verify.b{bb}x{mb}w{W}",
@@ -1717,15 +1865,18 @@ class DecodeEngine:
                 toks = self._sample(last, temps, seeds, steps)
                 return toks, tuple(outs[1:])
 
+            if self._mesh is not None:
+                prefill = self._mesh.prefill_step()
+
             i32 = np.dtype(np.int32)
             specs = (self._spec_of(self._params),
-                     jax.ShapeDtypeStruct((1, tp), i32),
-                     jax.ShapeDtypeStruct((1, tp), i32),
-                     jax.ShapeDtypeStruct((1,), i32),
-                     jax.ShapeDtypeStruct((1, mb), i32),
-                     jax.ShapeDtypeStruct((1,), np.dtype(np.float32)),
-                     jax.ShapeDtypeStruct((1,), i32),
-                     jax.ShapeDtypeStruct((1,), i32),
+                     self._arg_spec((1, tp), i32),
+                     self._arg_spec((1, tp), i32),
+                     self._arg_spec((1,), i32),
+                     self._arg_spec((1, mb), i32),
+                     self._arg_spec((1,), np.dtype(np.float32)),
+                     self._arg_spec((1,), i32),
+                     self._arg_spec((1,), i32),
                      self._spec_of(self._pools))
             with profiler.scope(f"serving.compile.prefill.t{tp}",
                                 "serving", args={"tokens": tp}):
@@ -1781,16 +1932,19 @@ class DecodeEngine:
                 toks = self._sample(last, temps, seeds, steps)
                 return toks, tuple(outs[1:])
 
+            if self._mesh is not None:
+                prefill = self._mesh.prefix_prefill_step()
+
             i32 = np.dtype(np.int32)
             specs = (self._spec_of(self._params),
-                     jax.ShapeDtypeStruct((1, tp), i32),
-                     jax.ShapeDtypeStruct((1, tp), i32),
-                     jax.ShapeDtypeStruct((1,), i32),
-                     jax.ShapeDtypeStruct((1,), i32),
-                     jax.ShapeDtypeStruct((1, mb), i32),
-                     jax.ShapeDtypeStruct((1,), np.dtype(np.float32)),
-                     jax.ShapeDtypeStruct((1,), i32),
-                     jax.ShapeDtypeStruct((1,), i32),
+                     self._arg_spec((1, tp), i32),
+                     self._arg_spec((1, tp), i32),
+                     self._arg_spec((1,), i32),
+                     self._arg_spec((1,), i32),
+                     self._arg_spec((1, mb), i32),
+                     self._arg_spec((1,), np.dtype(np.float32)),
+                     self._arg_spec((1,), i32),
+                     self._arg_spec((1,), i32),
                      self._spec_of(self._pools))
             with profiler.scope(
                     f"serving.compile.prefix_prefill.t{tp}x{mb}",
@@ -1810,8 +1964,12 @@ class DecodeEngine:
         if self._cow_fn is None:
             import jax
 
-            def copy(pools, src, dst):
-                return tuple(p.at[dst].set(p[src]) for p in pools)
+            if self._mesh is not None:
+                # stacked pools: page axis is 1 (behind the layer dim)
+                copy = self._mesh.cow_fn()
+            else:
+                def copy(pools, src, dst):
+                    return tuple(p.at[dst].set(p[src]) for p in pools)
 
             jitted = jax.jit(
                 copy, donate_argnums=(0,) if self._donate else ())

@@ -33,6 +33,10 @@ SERVE_CLIENTS (default "1,2,4,8,16,32,64"; CPU "1,4,8,16"),
 SERVE_REQUESTS (requests per client per point; default 64, CPU 12),
 SERVE_BUCKETS (default "1,8,32,128"; CPU "1,8,32"),
 SERVE_TIMEOUT_MS (default 2), SERVE_NAIVE_REQUESTS (default 64, CPU 24).
+Model-parallel mode (--tp N [--pp M]): TP_CLIENTS, TP_REQUESTS,
+TP_PROMPT, TP_NEW, TP_DEVICE_POOL_BYTES (per-device pool budget the
+tp=1 pool must exceed; see the "Model-parallel serving" PERF.md
+appendix).
 CPU fallback shrinks the models (ResNet-50 CIFAR-style at 32x32, a
 2-layer transformer) so the sweep finishes in minutes; on TPU the
 full-size models run.
@@ -895,6 +899,132 @@ def main_decode_mixed():
     }))
 
 
+# ---------------------------------------------------------------------------
+# --tp N [--pp M]: model-parallel decode through the serving mesh.
+#
+# Methodology (PERF.md appendix "Model-parallel serving"):
+# - The model+pool are sized so the KV pool alone EXCEEDS a per-device
+#   pool budget (TP_DEVICE_POOL_BYTES; default 60% of the tp=1 pool —
+#   on a real TPU slice this is the chip's free HBM after weights):
+#   tp=1 provably cannot hold it, the tp-sharded engine provably can.
+#   All byte numbers land in the JSON so the claim is checkable.
+# - The tp=1 reference point still RUNS (CPU backend has no real HBM
+#   wall) — that's what makes vs_tp1 measurable: same workload, same
+#   closed loop, per-device pool bytes cut to 1/(tp*pp).
+# - Decoded tokens are argmax (temperature 0): any cross-mesh numeric
+#   drift would change tokens, so throughput and correctness are the
+#   same run (the engine's tp bit-identity contract is separately
+#   enforced by tests/test_serving_mesh.py).
+# ---------------------------------------------------------------------------
+
+
+def build_tp_config(cpu):
+    # sized so the PAGED POOL dominates weights — the regime model-
+    # parallel serving exists for (pool scales with streams x context,
+    # weights don't)
+    if cpu:
+        return dict(vocab_size=512, num_layers=4, num_heads=4,
+                    d_model=128, max_len=256, kv_block=16)
+    return dict(vocab_size=8000, num_layers=8, num_heads=8,
+                d_model=512, max_len=2048, kv_block=32)
+
+
+def main_decode_tp():
+    import mxnet_tpu as mx
+    from mxnet_tpu.kv_cache import blocks_for_tokens, pool_device_bytes
+
+    tp = int(sys.argv[sys.argv.index("--tp") + 1])
+    pp = int(sys.argv[sys.argv.index("--pp") + 1]) \
+        if "--pp" in sys.argv else 1
+    backend = jax.default_backend()
+    cpu = backend == "cpu"
+    cfg = build_tp_config(cpu)
+    clients = int(os.environ.get("TP_CLIENTS", "4"))
+    per_client = int(os.environ.get("TP_REQUESTS", "3" if cpu else "8"))
+    pmin, pmax = _csv_ints(os.environ.get("TP_PROMPT",
+                                          "8,48" if cpu else "64,512"))
+    nmin, nmax = _csv_ints(os.environ.get("TP_NEW",
+                                          "8,24" if cpu else "64,256"))
+    max_streams = clients
+    cache_blocks = 1 + max_streams * blocks_for_tokens(
+        cfg["max_len"], cfg["kv_block"])
+    pool_tp1 = pool_device_bytes(
+        cache_blocks, cfg["kv_block"], cfg["num_layers"],
+        cfg["num_heads"], cfg["d_model"])
+    pool_tpn = pool_device_bytes(
+        cache_blocks, cfg["kv_block"], cfg["num_layers"],
+        cfg["num_heads"], cfg["d_model"], tp=tp, pp=pp)
+    budget = int(os.environ.get("TP_DEVICE_POOL_BYTES",
+                                int(pool_tp1 * 0.6)))
+    log(f"tp={tp} pp={pp} backend={backend} cfg={cfg} "
+        f"pool tp1={pool_tp1} sharded={pool_tpn} budget={budget}")
+    if not pool_tpn <= budget < pool_tp1:
+        log(f"WARNING: budget {budget} does not separate sharded "
+            f"({pool_tpn}) from tp=1 ({pool_tp1}) — size the model "
+            f"up or lower TP_DEVICE_POOL_BYTES")
+
+    params = build_lm_params(cfg)
+    weights_bytes = sum(
+        int(np.prod(v.shape)) * 4 for v in params.values())
+
+    def mk_request(rng):
+        p = rng.randint(pmin, pmax + 1)
+        n = rng.randint(nmin, nmax + 1)
+        return rng.randint(1, cfg["vocab_size"],
+                           size=p).astype(np.int32), n
+
+    def run(tp_, pp_):
+        eng = mx.DecodeEngine(
+            params, vocab_size=cfg["vocab_size"],
+            num_layers=cfg["num_layers"], num_heads=cfg["num_heads"],
+            d_model=cfg["d_model"], max_len=cfg["max_len"],
+            kv_block=cfg["kv_block"], max_streams=max_streams,
+            cache_blocks=cache_blocks, temperature=0.0,
+            tp=tp_, pp=pp_, prewarm=True)
+        try:
+            pt = bench_decode_point(eng, mk_request, clients,
+                                    per_client)
+            pt["pool_bytes_per_device"] = \
+                eng.stats()["pool_bytes_per_device"]
+            return pt
+        finally:
+            eng.close()
+
+    base = run(1, 1)
+    log(f"tp=1: {base['tokens_s']:.1f} tok/s, p50 "
+        f"{base['p50_ms']:.1f} ms, pool/dev "
+        f"{base['pool_bytes_per_device']}")
+    pt = run(tp, pp)
+    log(f"tp={tp} pp={pp}: {pt['tokens_s']:.1f} tok/s, p50 "
+        f"{pt['p50_ms']:.1f} ms, pool/dev "
+        f"{pt['pool_bytes_per_device']}")
+    print(json.dumps({
+        "metric": "serving_tp_decode",
+        "value": pt["tokens_s"],
+        "unit": "tokens/s",
+        "backend": backend,
+        "model": "transformer_lm",
+        "config": cfg,
+        "tp": tp,
+        "pp": pp,
+        "clients": clients,
+        "tokens_s": pt["tokens_s"],
+        "p50_ms": pt["p50_ms"],
+        "p99_ms": pt["p99_ms"],
+        "ttft_p50_ms": pt["ttft_p50_ms"],
+        "pool_bytes_per_device": pt["pool_bytes_per_device"],
+        "pool_bytes_tp1": base["pool_bytes_per_device"],
+        "weights_bytes": weights_bytes,
+        "device_pool_budget_bytes": budget,
+        "fits_one_device": bool(pool_tp1 <= budget),
+        "fits_sharded": bool(pool_tpn <= budget),
+        "tokens_s_tp1": base["tokens_s"],
+        "vs_tp1": round(pt["tokens_s"] / max(base["tokens_s"], 1e-9),
+                        3),
+        "generations": pt["generations"],
+    }))
+
+
 def main():
     import mxnet_tpu as mx
 
@@ -990,5 +1120,7 @@ if __name__ == "__main__":
         main_decode_mixed()
     elif "--decode" in sys.argv:
         main_decode()
+    elif "--tp" in sys.argv:
+        main_decode_tp()
     else:
         main()
