@@ -54,6 +54,8 @@ from . import operator
 from . import rtc
 from . import predictor
 from .predictor import Predictor
+from . import slo
+from .slo import SloTracker, SloAlert, CanaryProber
 from . import serving
 from .serving import (InferenceEngine, DecodeEngine, EngineClosedError,
                       ReplicaHarness)

@@ -27,7 +27,10 @@ env point                               effect
                                         dies) — exercises the bounded
                                         reconnect path.
 ``MXNET_CHAOS_SLOW_RANK=<s>``           sleep ``s`` seconds at every fit
-                                        step (straggler fault).
+                                        step AND every serving decode
+                                        step (straggler / slow-replica
+                                        fault — the SLO burn-rate
+                                        drill's injection point).
 ``MXNET_CHAOS_RANK=<r>``                faults apply only on rank ``r``
                                         (default: every rank).
 ======================================  =================================
@@ -124,6 +127,16 @@ class Chaos:
                 "DeadRankError(%s)", self.dead_rank_step, self.dead_ranks)
             raise DeadRankError(self.dead_ranks,
                                 detail="chaos-injected dead-rank fault")
+
+    # -- serving fault ------------------------------------------------
+    def on_decode_step(self, rank: Optional[int] = None) -> None:
+        """Called at the top of each serving decode/verify step: the
+        slow-rank fault stretches every step, inflating TTFT and
+        time-per-token while the replica's heartbeat stays fresh —
+        exactly the failure mode the heartbeat conviction window can
+        NEVER catch and the SLO fast-window burn alert must."""
+        if self.slow_rank and self._applies(rank):
+            time.sleep(self.slow_rank)
 
     # -- heartbeat fault ----------------------------------------------
     def heartbeat_stall_s(self, rank: Optional[int] = None) -> float:

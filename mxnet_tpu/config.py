@@ -293,8 +293,10 @@ register_env(
     "reconnect.  NEVER set in production.")
 register_env(
     "MXNET_CHAOS_SLOW_RANK", None, float,
-    "CHAOS: sleep S seconds at every fit step (straggler fault).  "
-    "NEVER set in production.")
+    "CHAOS: sleep S seconds at every fit step AND every serving "
+    "decode step (straggler / slow-replica fault — the SLO engine's "
+    "burn-rate drill: a slow replica still heartbeats, so only the "
+    "fast-window alert catches it).  NEVER set in production.")
 register_env(
     "MXNET_CHAOS_RANK", None, int,
     "CHAOS: apply the MXNET_CHAOS_* faults only on this rank "
@@ -612,6 +614,63 @@ register_env(
     "kind (TPU v4/v5e/v5p/v6); REQUIRED for MFU on CPU meshes and "
     "unlisted hardware (the gauge is withheld rather than guessed).  "
     "Non-positive or garbage values raise at first use.")
+register_env(
+    "MXNET_SLO_TTFT_MS", "interactive=250,batch=5000", str,
+    "Per-class time-to-first-token SLO targets, as 'class=ms,...' "
+    "over the declared classes (slo.SLO_CLASSES: interactive, "
+    "batch).  A TTFT above its class target is one bad event for the "
+    "burn-rate engine.  Unknown classes, garbage or non-positive "
+    "values raise at SloConfig construction naming this var.")
+register_env(
+    "MXNET_SLO_TPT_MS", "interactive=50,batch=500", str,
+    "Per-class time-per-token SLO targets ('class=ms,...'; see "
+    "MXNET_SLO_TTFT_MS for the format and validation).  Each decoded "
+    "token's step share is judged against its class target.")
+register_env(
+    "MXNET_SLO_OBJECTIVE", 0.99, float,
+    "Fraction of events that must be GOOD for every (class, metric) "
+    "objective — the error budget is 1 - objective, the denominator "
+    "of every burn rate.  Must be in (0, 1): 1.0 leaves a zero "
+    "budget.  Garbage or out-of-range values raise at SloConfig "
+    "construction.")
+register_env(
+    "MXNET_SLO_FAST_WINDOW", 60.0, float,
+    "Fast burn-rate window in seconds (SRE multi-window style; the "
+    "paging signal).  Must be >= 1 and < MXNET_SLO_SLOW_WINDOW.  A "
+    "sustained fast-window burn above MXNET_SLO_BURN_ALERT fires the "
+    "typed SloAlert — designed to trip BEFORE a slow replica's "
+    "MXNET_DEAD_RANK_TIMEOUT conviction window (which never fires "
+    "for a replica that still heartbeats).")
+register_env(
+    "MXNET_SLO_SLOW_WINDOW", 600.0, float,
+    "Slow burn-rate window in seconds — the budget_remaining gauge's "
+    "horizon and the flap damper.  Must exceed "
+    "MXNET_SLO_FAST_WINDOW.")
+register_env(
+    "MXNET_SLO_BURN_ALERT", 10.0, float,
+    "Fast-window burn-rate alert threshold (1.0 = budget spent "
+    "exactly on schedule).  Alerts re-arm after burn falls below "
+    "half this (hysteresis).  Must be >= 1; garbage raises at "
+    "SloConfig construction.")
+register_env(
+    "MXNET_SLO_MIN_EVENTS", 10, int,
+    "Minimum events in the fast window before a burn-rate alert may "
+    "fire (a 1-request window would alert on any single miss).  "
+    "Must be >= 1.")
+register_env(
+    "MXNET_CANARY_INTERVAL", 0.0, float,
+    "Seconds between synthetic canary probes (DecodeEngine and "
+    "fleet.Router each run a prober when set).  0/unset (default): "
+    "prober off.  Probes ride the full admission→prefill→decode→"
+    "deliver path, are EXCLUDED from serving.requests / "
+    "fleet.requests, and export slo.canary_* metrics feeding the "
+    "availability objective.  Negative or garbage values raise at "
+    "construction.")
+register_env(
+    "MXNET_CANARY_TOKENS", 4, int,
+    "Decode length of one canary probe — with the fixed probe prompt "
+    "this pins the probe's cost, so canary latency is comparable "
+    "across time.  Must be >= 1.")
 register_env(
     "MXNET_TEST_DEVICE", None, str,
     "Device the test utilities bind to (test_utils.default_context; "

@@ -68,6 +68,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import profiler
+from . import slo as _slo
 from . import wire
 from .base import MXNetError
 from .elastic import (HeartbeatWriter, dead_rank_timeout,
@@ -702,9 +703,10 @@ class _Ticket:
     __slots__ = ("tid", "spec", "deadline", "units", "attempts",
                  "rid", "t_submit", "t_dispatch", "future", "delivered",
                  "queued", "trace", "t_enqueue", "tp_submit",
-                 "tp_dispatch", "trace_owned")
+                 "tp_dispatch", "trace_owned", "slo_class", "canary")
 
-    def __init__(self, tid, spec, deadline, units, future, trace=None):
+    def __init__(self, tid, spec, deadline, units, future, trace=None,
+                 slo_class="interactive", canary=False):
         self.tid = tid
         self.spec = spec
         self.deadline = deadline      # absolute monotonic, or None
@@ -723,6 +725,8 @@ class _Ticket:
         self.t_enqueue = self.tp_submit  # (re)joined the queue
         self.tp_dispatch = 0.0
         self.trace_owned = False  # router created the root span
+        self.slo_class = slo_class  # validated at _accept()
+        self.canary = canary        # excluded from request counters
 
 
 class _ReplicaState:
@@ -819,6 +823,9 @@ class Router:
         # provable = admit (measure instead of assume).
         self._cost: Dict[Tuple[str, int], float] = {}
         self._metrics = profiler.MetricsRegistry()
+        # assigned BEFORE the worker threads exist: both loops book
+        # delivery/shed outcomes into the process-wide tracker
+        self._slo = _slo.get_tracker()
 
         self._server = None
         self._dispatcher = threading.Thread(
@@ -834,6 +841,21 @@ class Router:
         # endpoint itself is MXNET_METRICS_PORT-gated
         profiler.maybe_start_metrics_server()
         profiler.register_statusz("router", self.stats)
+        # optional canary prober: keeps availability and latency
+        # observable at zero traffic (MXNET_CANARY_INTERVAL=0 leaves
+        # it off).  The probe rides the FULL routed path — accept →
+        # dispatch → replica → deliver — as a canary ticket.
+        self._canary = None
+        interval = _slo.canary_interval_s()
+        if interval > 0:
+            def _probe(trace):
+                self.generate(
+                    _slo.canary_prompt(4),
+                    max_new_tokens=_slo.canary_tokens(),
+                    trace=trace, canary=True).result(timeout=60.0)
+
+            self._canary = _slo.CanaryProber(
+                _probe, interval, tracker=self._slo, name="router")
 
     # -- metrics --------------------------------------------------------
     def _count(self, name, value=1.0):
@@ -860,15 +882,20 @@ class Router:
 
     def generate(self, prompt, max_new_tokens=32, temperature=None,
                  eos_id=None, deadline_ms: Optional[float] = None,
-                 seed: Optional[int] = None, trace=None) -> Future:
+                 seed: Optional[int] = None, trace=None,
+                 slo_class: str = "interactive",
+                 canary: bool = False) -> Future:
         """Route one generation; the Future resolves to the np.int32
-        generated tokens."""
+        generated tokens.  ``slo_class`` keys the burn-rate windows the
+        delivery outcome lands in; ``canary=True`` marks a synthetic
+        probe (full routed path, excluded from ``fleet.requests``)."""
         spec = {"kind": "decode",
                 "prompt": np.asarray(prompt, dtype=np.int32),
                 "max_new": int(max_new_tokens), "temperature": temperature,
                 "eos": eos_id, "seed": 0}
         return self._accept(spec, deadline_ms, units=int(max_new_tokens),
-                            seed=seed, trace=trace)
+                            seed=seed, trace=trace, slo_class=slo_class,
+                            canary=canary)
 
     @staticmethod
     def _infer_units(inputs) -> int:
@@ -878,7 +905,9 @@ class Router:
         return 1
 
     def _accept(self, spec, deadline_ms, units, seed=None,
-                trace=None) -> Future:
+                trace=None, slo_class="interactive",
+                canary=False) -> Future:
+        _slo.check_class(slo_class)
         fut: Future = Future()
         with self._cond:
             if not self._alive:
@@ -903,12 +932,13 @@ class Router:
                 trace = profiler.make_trace(key=tid)
                 owned = trace is not None
             t = _Ticket(tid, spec, deadline, max(1, units), fut,
-                        trace=trace)
+                        trace=trace, slo_class=slo_class, canary=canary)
             t.trace_owned = owned
             self._pending.append(t)
             profiler.set_gauge("fleet.pending", len(self._pending))
             self._cond.notify_all()
-        self._count("requests")
+        if not canary:  # probes keep request counters honest
+            self._count("requests")
         return fut
 
     # -- cost model -----------------------------------------------------
@@ -1097,6 +1127,8 @@ class Router:
         t.queued = False
         self._count("shed")
         self._count(f"shed_{reason}")
+        if not t.canary:  # a shed request spent availability budget
+            self._slo.observe_avail(t.slo_class, False)
         if t.trace is not None:
             profiler.trace_point(
                 "router.shed", t.trace.child(), cat="fleet",
@@ -1216,6 +1248,11 @@ class Router:
         lat_ms = (time.monotonic() - t.t_submit) * 1e3
         self._metrics.observe("latency_ms", lat_ms)
         profiler.observe("fleet.latency_ms", lat_ms)
+        if not t.canary:
+            # the delivery outcome feeds the availability objective; a
+            # canary ticket's outcome is the PROBER's to book (it also
+            # sees probe failures this path never reaches)
+            self._slo.observe_avail(t.slo_class, exc is None)
         if t.trace is not None:
             now_p = time.perf_counter()
             # the router-residency span (submit → delivery).  When the
@@ -1412,6 +1449,8 @@ class Router:
         out["cost_model_ms"] = {f"{k}:{b}": round(v, 3)
                                 for (k, b), v in sorted(self._cost.items())}
         out["latency_breakdown"] = self.latency_breakdown()
+        # the one-glance judgment bit (full detail: /statusz "slo")
+        out["slo_alert_active"] = self._slo.alert_active()
         return out
 
     def latency_breakdown(self) -> Dict:
@@ -1549,6 +1588,10 @@ class Router:
 
     # -- lifecycle ------------------------------------------------------
     def close(self, stop_replicas: bool = False):
+        canary = getattr(self, "_canary", None)
+        if canary is not None:  # stop probing BEFORE the door shuts
+            canary.stop()
+            self._canary = None
         with self._cond:
             if not self._alive:
                 return

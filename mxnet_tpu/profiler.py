@@ -32,10 +32,10 @@ go, across every worker" question — Sigelman et al. 2010):
   bench and the reporter share one schema.
 * exporters — ``prometheus_text()`` renders the registry in the
   Prometheus text exposition format (real ``histogram``
-  ``_bucket``/``_sum``/``_count`` series since PR 12; the old
-  percentile flattening rides along as ``_p50``/``_p90``/``_p99``
-  gauges for one release); ``start_reporter(path, interval)`` appends
-  a JSONL summary line every interval from a daemon thread.
+  ``_bucket``/``_sum``/``_count`` series since PR 12; the pre-PR-12
+  ``_p50``/``_p90``/``_p99`` quantile gauges are retired — use
+  ``histogram_quantile()``); ``start_reporter(path, interval)``
+  appends a JSONL summary line every interval from a daemon thread.
 
 The fleet-era additions (PR 12 — Dapper-style per-REQUEST accounting
 across processes, and the "what was this process doing when it died"
@@ -913,10 +913,11 @@ def prometheus_text(registry: MetricsRegistry | None = None,
     ``_bucket{le=...}`` series over the fixed
     :attr:`MetricsRegistry.BUCKET_BOUNDS` ladder plus exact
     ``_sum``/``_count`` — so server-side ``histogram_quantile()``
-    works and histograms aggregate across ranks.  The previous
-    percentile flattening remains for one release as ``_p50``/
-    ``_p90``/``_p99`` gauges (README "Observability" notes the
-    rename).  Serve it from any HTTP handler (``/metrics`` via
+    works and histograms aggregate across ranks.  (The pre-PR-12
+    ``_p50``/``_p90``/``_p99`` quantile gauges rode along for one
+    release and are now RETIRED — use ``histogram_quantile()`` over
+    the ``_bucket`` series.)  Serve it from any HTTP handler
+    (``/metrics`` via
     :func:`start_metrics_server`), or dump it periodically next to
     the JSONL reporter — both views read the same registry, so
     ``serving.*`` counters and the training gauges show up with no
@@ -947,13 +948,6 @@ def prometheus_text(registry: MetricsRegistry | None = None,
         lines.append(f'{m}_count{{rank="{rank}"}} {h["count"]}')
         lines.append(f'{m}_sum{{rank="{rank}"}} '
                      f'{h.get("sum", h["mean"] * h["count"]):g}')
-        # deprecated compat series (one release): the old quantile
-        # flattening, renamed from <name>{quantile=...} to _pNN gauges
-        # so the histogram family above stays a valid exposition
-        for suffix, key in (("p50", "p50"), ("p90", "p90"),
-                            ("p99", "p99")):
-            lines.append(f"# TYPE {m}_{suffix} gauge")
-            lines.append(f'{m}_{suffix}{{rank="{rank}"}} {h[key]:g}')
     return "\n".join(lines) + "\n"
 
 

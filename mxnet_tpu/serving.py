@@ -44,6 +44,8 @@ import numpy as np
 
 from .base import MXNetError, get_env
 from . import profiler
+from . import slo as _slo
+from .chaos import get_chaos
 
 __all__ = ["InferenceEngine", "DecodeEngine", "EngineClosedError",
            "ReplicaHarness"]
@@ -906,10 +908,11 @@ class _Stream:
     __slots__ = ("sid", "prompt", "max_new", "temp", "eos", "future",
                  "seed", "generated", "blocks", "length", "next_token",
                  "resume", "t_submit", "t_admit", "trace", "t_enqueue",
-                 "cached_len", "await_first", "t_chunk0")
+                 "cached_len", "await_first", "t_chunk0", "slo_class",
+                 "canary", "cost")
 
     def __init__(self, sid, prompt, max_new, temp, eos, future, seed,
-                 trace=None):
+                 trace=None, slo_class="interactive", canary=False):
         self.sid = sid
         self.prompt = prompt          # np.int32 (P,)
         self.max_new = max_new
@@ -929,6 +932,10 @@ class _Stream:
         self.cached_len = 0           # prefix-cache tokens attached
         self.await_first = False      # full hit: first token pending
         self.t_chunk0 = 0.0           # chunked prefill: first chunk start
+        self.slo_class = slo_class    # validated at submit()
+        self.canary = canary          # excluded from request counters
+        self.cost = _slo.CostRecord(sid, slo_class, canary)
+        self.cost.prompt_tokens = int(prompt.size)
 
     def prefill_seq(self) -> np.ndarray:
         """Token sequence whose K/V the cache must hold before the
@@ -1347,7 +1354,12 @@ class DecodeEngine:
         self._exe_cache: Dict[tuple, Any] = {}
         self._compile_lock = threading.Lock()
         self.compiles: Dict[tuple, int] = {}
+        # per-executable FLOPs (XLA cost analysis, cached at compile)
+        # feeding each stream's cost record's flops_est
+        self._exe_flops: Dict[tuple, float] = {}
         self._metrics = profiler.MetricsRegistry()
+        self._cost_agg = _slo.CostAggregator()
+        self._slo = _slo.get_tracker()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._pending: List[_Stream] = []
@@ -1374,13 +1386,38 @@ class DecodeEngine:
             name="mxnet_tpu-serving-decode")
         self._thread.start()
 
+        # synthetic canary prober (MXNET_CANARY_INTERVAL-gated): a
+        # known-cost probe through the full admission→prefill→decode
+        # path, excluded from serving.requests, feeding slo.canary_*
+        self._canary = None
+        interval = _slo.canary_interval_s()
+        if interval > 0:
+            probe_prompt = _slo.canary_prompt(int(vocab_size))
+            probe_new = min(_slo.canary_tokens(),
+                            self._max_len - probe_prompt.size)
+
+            def _probe(trace):
+                self.submit(probe_prompt, max_new_tokens=probe_new,
+                            trace=trace, canary=True).result(timeout=60)
+
+            self._canary = _slo.CanaryProber(
+                _probe, interval, tracker=self._slo, name="engine",
+                book_latency=False)  # the engine path books real
+            # TTFT/TPT for canary streams; the prober adds avail only
+
     # ------------------------------------------------------------------
     # client surface
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, temperature=None,
-               eos_id=None, seed=None, trace=None) -> Future:
+               eos_id=None, seed=None, trace=None,
+               slo_class="interactive", canary=False) -> Future:
         """Enqueue one generation; the Future resolves to the np.int32
         array of generated token ids (eos, when hit, is included).
+
+        ``slo_class`` ("interactive"/"batch", loudly validated) keys
+        the request's SLO objectives and its cost-record aggregation;
+        ``canary=True`` marks a synthetic probe — it rides the normal
+        path but is EXCLUDED from the ``requests`` counter.
 
         ``seed`` overrides the stream's sampling seed (default: the
         engine-local stream id).  Sampling is keyed by (engine seed,
@@ -1393,6 +1430,7 @@ class DecodeEngine:
         stream's queue wait, prefill, and every decode-step batch it
         rides in become child spans of it (propagated over the fleet
         wire; purely an observer)."""
+        _slo.check_class(slo_class)
         prompt = np.asarray(prompt)
         if prompt.ndim != 1 or prompt.size < 1:
             raise MXNetError(
@@ -1428,13 +1466,15 @@ class DecodeEngine:
                     self._reject or "DecodeEngine is closed")
             s = _Stream(self._next_sid, prompt, max_new, temp, eos, fut,
                         seed=(self._next_sid + 1 if seed is None
-                              else int(seed)), trace=trace)
+                              else int(seed)), trace=trace,
+                        slo_class=slo_class, canary=canary)
             self._next_sid += 1
             self._pending.append(s)
             self._owned.add(fut)
             self._cond.notify_all()
         fut.add_done_callback(self._disown)
-        self._count("requests")
+        if not canary:  # probes keep request counters honest
+            self._count("requests")
         return fut
 
     def _disown(self, fut):
@@ -1559,6 +1599,7 @@ class DecodeEngine:
         :meth:`stats` covers only work from this point on (benchmarks
         isolate sweep points; lifetime percentiles blend loads)."""
         self._metrics.reset()
+        self._cost_agg.reset()
         if self._prefix is not None:
             self._prefix.reset_counters()
 
@@ -1630,7 +1671,19 @@ class DecodeEngine:
                    "ttft": "ttft_ms",
                    "ttft_hit": "ttft_hit_ms",
                    "ttft_miss": "ttft_miss_ms"})
+        # per-class cost attribution (retired streams only) + the
+        # FLOP rate the tenant-quota layer will meter against
+        out["cost_by_class"] = self._cost_agg.by_class()
+        out["cost_flops_per_s"] = round(
+            summ["rates"].get("cost_flops", 0.0), 3)
         return out
+
+    def cost_records(self) -> List[dict]:
+        """The retained tail of per-stream cost records (newest last):
+        one dict per retired stream, keyed by ``slo.COST_FIELDS`` plus
+        sid/slo_class/canary/wall_s — what the conservation test sums
+        against the engine counters."""
+        return list(self._cost_agg.records)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -1639,6 +1692,10 @@ class DecodeEngine:
         """Stop accepting work and fail every outstanding generation
         with :class:`EngineClosedError` at the next step boundary —
         in-flight decodes never strand their futures."""
+        canary = getattr(self, "_canary", None)
+        if canary is not None:  # stop probing BEFORE the door shuts
+            canary.stop()
+            self._canary = None
         with self._cond:
             if not self._alive:
                 return
@@ -1771,6 +1828,7 @@ class DecodeEngine:
                     donate_argnums=(8,) if self._donate else ())
                 exe = jitted.lower(*specs).compile()
             self._exe_cache[key] = exe
+            self._exe_flops[key] = _slo.executable_flops(exe)
             self.compiles[key] = self.compiles.get(key, 0) + 1
             return exe
 
@@ -1833,6 +1891,7 @@ class DecodeEngine:
                     donate_argnums=(9,) if self._donate else ())
                 exe = jitted.lower(*specs).compile()
             self._exe_cache[key] = exe
+            self._exe_flops[key] = _slo.executable_flops(exe)
             self.compiles[key] = self.compiles.get(key, 0) + 1
             return exe
 
@@ -1885,6 +1944,7 @@ class DecodeEngine:
                     donate_argnums=(8,) if self._donate else ())
                 exe = jitted.lower(*specs).compile()
             self._exe_cache[key] = exe
+            self._exe_flops[key] = _slo.executable_flops(exe)
             self.compiles[key] = self.compiles.get(key, 0) + 1
             return exe
 
@@ -1954,6 +2014,7 @@ class DecodeEngine:
                     donate_argnums=(9,) if self._donate else ())
                 exe = jitted.lower(*specs).compile()
             self._exe_cache[key] = exe
+            self._exe_flops[key] = _slo.executable_flops(exe)
             self.compiles[key] = self.compiles.get(key, 0) + 1
             return exe
 
@@ -2110,6 +2171,7 @@ class DecodeEngine:
                 cached, pages = self._prefix.attach(seq, owner=s.sid)
             else:
                 cached, pages = 0, []
+            s.cost.book_pages(0)  # page-second clock starts at attach
             s.blocks = pages  # attach now: a dying prefill must not leak
             s.cached_len = cached
             if chunked:
@@ -2125,6 +2187,7 @@ class DecodeEngine:
                 raise MXNetError(
                     f"admission raced the allocator: {need} pages "
                     f"unavailable after the capacity check")
+            s.cost.book_pages(len(s.blocks))
             s.blocks = pages + new_pages
             if cached == len(seq) and cached > 0:
                 self._full_hit(s, seq)
@@ -2202,6 +2265,8 @@ class DecodeEngine:
                 stage_array(lengths, dev), stage_array(table, dev),
                 stage_array(temps, dev), stage_array(seeds, dev),
                 stage_array(steps, dev), self._pools)
+        s.cost.flops_est += self._exe_flops.get(
+            ("prefix_prefill", tp, mb), 0.0)
         return toks, tp
 
     def _prefill(self, s: _Stream, seq: np.ndarray, pages: List[int]):
@@ -2245,6 +2310,11 @@ class DecodeEngine:
                     stage_array(seeds, dev), stage_array(steps, dev),
                     self._pools)
                 first = int(np.asarray(toks)[0])
+            s.cost.flops_est += self._exe_flops.get(("prefill", tp),
+                                                    0.0)
+        # both branches just fetched the sampled first token
+        self._count("d2h_syncs")
+        s.cost.d2h_syncs += 1
         s.blocks = pages
         s.length = n
         self._finish_prefill(s, first, n, ns, c, tp, t_pre0,
@@ -2296,9 +2366,12 @@ class DecodeEngine:
             split = "ttft_hit_ms" if c else "ttft_miss_ms"
             self._metrics.observe(split, ttft)
             profiler.observe(f"serving.{split}", ttft)
+            self._slo.observe_ttft(s.slo_class, ttft)
             self._count("tokens")
+            s.cost.tokens += 1  # same site as the engine counter
         self._count("prefills")
         self._count("prefill_tokens", ns)  # uncached tokens only
+        s.cost.prefill_tokens += ns
         if s.done():  # max_new == 1 or instant eos
             self._retire(s)
         else:
@@ -2325,6 +2398,7 @@ class DecodeEngine:
             pages = self._palloc(need, owner=s.sid)
             if pages is None:
                 return  # pool dry: retry after the next decode step
+            s.cost.book_pages(len(s.blocks))
             s.blocks.extend(pages)
         t0 = time.perf_counter()
         if done == s.cached_len:
@@ -2341,6 +2415,7 @@ class DecodeEngine:
         if end >= n:
             first = int(np.asarray(toks)[0])
             self._count("d2h_syncs")
+            s.cost.d2h_syncs += 1  # the final chunk's token fetch
         t_done = time.perf_counter()
         self._count("prefill_chunks")
         self._metrics.observe("prefill_chunk_ms", (t_done - t0) * 1e3)
@@ -2382,8 +2457,11 @@ class DecodeEngine:
             if not victims:
                 with self._lock:
                     self._active.remove(s)
+                s.cost.book_pages(len(s.blocks))
                 self._release_pages(s.blocks)
                 s.blocks = []
+                if not s.canary:
+                    self._slo.observe_avail(s.slo_class, False)
                 if s.future.set_running_or_notify_cancel():
                     s.future.set_exception(MXNetError(
                         f"KV cache exhausted: stream {s.sid} needs a "
@@ -2420,6 +2498,7 @@ class DecodeEngine:
         pages = self._alloc_with_preempt(s, need)
         if pages is None:
             return False
+        s.cost.book_pages(len(s.blocks))
         s.blocks.extend(pages)
         return True
 
@@ -2451,6 +2530,7 @@ class DecodeEngine:
         s.blocks[j] = new
         self._prefix.release([page])  # drop OUR ref; sharers keep it
         self._prefix.note_cow()
+        s.cost.cow_copies += 1  # same site as the cache's counter
         return True
 
     def _preempt(self, victim: _Stream):
@@ -2459,6 +2539,7 @@ class DecodeEngine:
         Shared pages lose only the victim's reference — sharers keep
         reading them, and the victim's re-admission will usually
         re-attach them as a prefix hit."""
+        victim.cost.book_pages(len(victim.blocks))
         self._release_pages(victim.blocks)
         victim.blocks = []
         victim.length = 0
@@ -2474,12 +2555,20 @@ class DecodeEngine:
         self._count("preempted")
 
     def _retire(self, s: _Stream):
+        s.cost.book_pages(len(s.blocks))
         if s.blocks:
             self._release_pages(s.blocks)
             s.blocks = []
         if s.future.set_running_or_notify_cancel():
             s.future.set_result(np.asarray(s.generated, np.int32))
         self._count("generations")
+        self._cost_agg.add(s.cost)
+        if s.cost.flops_est:
+            self._count("cost_flops", s.cost.flops_est)
+        if not s.canary:
+            # canary delivery outcomes are the PROBER's to book (it
+            # also sees the failures this path never reaches)
+            self._slo.observe_avail(s.slo_class, True)
 
     def _propose(self, s: _Stream) -> np.ndarray:
         """Draft tokens for one stream, capped by the step's usable
@@ -2496,6 +2585,10 @@ class DecodeEngine:
         return d[:room]
 
     def _decode_step(self):
+        # chaos injection point: MXNET_CHAOS_SLOW_RANK stretches every
+        # step while the heartbeat stays fresh — the straggler the SLO
+        # fast-window burn alert must catch before conviction would
+        get_chaos().on_decode_step()
         if self._spec_k:
             with self._lock:
                 streams = list(self._active)
@@ -2588,6 +2681,8 @@ class DecodeEngine:
         self._count("spec_proposed", proposed)
         self._metrics.observe("step_ms", step_ms)
         profiler.observe("serving.decode_step_ms", step_ms)
+        # the batch program's FLOPs, split evenly across the riders
+        fl = self._exe_flops.get(("verify", bb, mb, W), 0.0) / n
         retired = []
         for i, s in enumerate(streams):
             d = fed[i][1:]
@@ -2607,6 +2702,11 @@ class DecodeEngine:
             s.next_token = s.generated[-1]
             self._count("tokens", t)
             self._count("spec_accepted", t - 1)
+            s.cost.tokens += t  # same sites as the engine counters
+            s.cost.spec_accepted += t - 1
+            s.cost.decode_steps += 1
+            s.cost.d2h_syncs += 1
+            s.cost.flops_est += fl
             if s.await_first:
                 s.await_first = False
                 ttft = (t_done - s.t_submit) * 1e3
@@ -2614,15 +2714,18 @@ class DecodeEngine:
                 profiler.observe("serving.ttft_ms", ttft)
                 self._metrics.observe("ttft_hit_ms", ttft)
                 profiler.observe("serving.ttft_hit_ms", ttft)
+                self._slo.observe_ttft(s.slo_class, ttft)
             per_tok = step_ms / t
             for _ in range(t):
                 self._metrics.observe("time_per_token_ms", per_tok)
                 profiler.observe("serving.time_per_token_ms", per_tok)
+                self._slo.observe_tpt(s.slo_class, per_tok)
             # rejected-token rollback: pages past the committed tail
             # (+ the pending token's slot) held only rejected writes
             keep, surplus = trim_blocks(s.blocks, s.length + 1,
                                         self._kv_block)
             if surplus:
+                s.cost.book_pages(len(s.blocks))
                 s.blocks = keep
                 self._release_pages(surplus)
                 self._count("spec_pages_rolled_back", len(surplus))
@@ -2691,6 +2794,8 @@ class DecodeEngine:
                           max(len(s.blocks) for s in streams),
                           "cache blocks")
         exe = self._decode_exe(bb, mb)
+        # the batch program's FLOPs, split evenly across the riders
+        fl = self._exe_flops.get(("decode", bb, mb), 0.0) / n
         tokens = np.zeros((bb, 1), np.int32)
         positions = np.zeros((bb, 1), np.int32)
         lengths = np.zeros((bb,), np.int32)
@@ -2722,7 +2827,7 @@ class DecodeEngine:
             toks = np.asarray(toks_dev)
             self._count("d2h_syncs")
             t_done = time.perf_counter()
-            self._absorb_step(streams, toks, t0, t_done, bb, n)
+            self._absorb_step(streams, toks, t0, t_done, bb, n, fl)
             return
         # step t+1, fed from the device: live rows advance one
         # position; pad rows stay dead (lengths 0 keeps their write on
@@ -2747,13 +2852,14 @@ class DecodeEngine:
         t_mid = time.perf_counter()
         # no retires possible (predicate): t+1's assumed composition
         # held, so its results are the real step t+1
-        self._absorb_step(streams, toks, t0, t_mid, bb, n)
+        self._absorb_step(streams, toks, t0, t_mid, bb, n, fl)
         toks2 = np.asarray(toks2_dev)
         self._count("d2h_syncs")
         t_done = time.perf_counter()
-        self._absorb_step(streams, toks2, t_mid, t_done, bb, n)
+        self._absorb_step(streams, toks2, t_mid, t_done, bb, n, fl)
 
-    def _absorb_step(self, streams, toks, t0, t_done, bb, n):
+    def _absorb_step(self, streams, toks, t0, t_done, bb, n,
+                     fl: float = 0.0):
         """Book one plain decode step's results into the scheduler:
         counters, per-stream token append, full-hit TTFT, trace spans,
         retirement."""
@@ -2769,6 +2875,10 @@ class DecodeEngine:
             s.generated.append(tok)
             s.length += 1
             s.next_token = tok
+            s.cost.tokens += 1  # same site as the engine counter
+            s.cost.decode_steps += 1
+            s.cost.d2h_syncs += 1
+            s.cost.flops_est += fl
             if s.await_first:
                 # fully-cached prompt: the first token came from this
                 # decode step — TTFT collapsed to one step's wall
@@ -2778,8 +2888,10 @@ class DecodeEngine:
                 profiler.observe("serving.ttft_ms", ttft)
                 self._metrics.observe("ttft_hit_ms", ttft)
                 profiler.observe("serving.ttft_hit_ms", ttft)
+                self._slo.observe_ttft(s.slo_class, ttft)
             self._metrics.observe("time_per_token_ms", step_ms)
             profiler.observe("serving.time_per_token_ms", step_ms)
+            self._slo.observe_tpt(s.slo_class, step_ms)
             if s.trace is not None:
                 # every decode-step batch this stream rode in becomes
                 # one child span — a request's flame graph shows its

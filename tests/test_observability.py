@@ -291,9 +291,11 @@ def test_prometheus_text():
     assert 'mxnet_serving_latency_ms_bucket{rank="0",le="+Inf"} 3' in text
     assert 'mxnet_serving_latency_ms_count{rank="0"} 3' in text
     assert 'mxnet_serving_latency_ms_sum{rank="0"} 6' in text
-    # the old percentile flattening survives one release as _pNN gauges
-    assert "# TYPE mxnet_serving_latency_ms_p99 gauge" in text
-    assert 'mxnet_serving_latency_ms_p50{rank="0"} 2' in text
+    # the one-release deprecated _pNN quantile gauges are RETIRED:
+    # histogram_quantile() over the _bucket series replaces them
+    assert "_p50" not in text
+    assert "_p90" not in text
+    assert "_p99" not in text
     # the pre-PR-12 summary form is GONE (a histogram family plus a
     # same-name summary would be an invalid exposition)
     assert "summary" not in text

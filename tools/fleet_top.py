@@ -6,7 +6,11 @@ Polls the per-process ``/statusz`` ops endpoints (see
 renders one row per process: replica id, pid, engine kind, inflight,
 active streams, cache utilization, tokens/s, p99, weight step,
 membership epoch, goodput/MFU — so a fleet under load is inspectable
-without attaching a debugger to any process.
+without attaching a debugger to any process.  When some process
+exports an ``slo`` statusz section the table grows the SLO columns —
+worst-burning class/metric, fast-window burn rate (``!`` = alert
+active), budget remaining, canary p50, attributed FLOP rate — and
+keeps the classic layout for fleets without an SLO config.
 
 Endpoints come from either:
 
@@ -80,11 +84,34 @@ def _pick(doc: Dict, *path, default=None):
     return cur
 
 
-def rows(docs: List[Tuple[str, str, Optional[Dict]]]) -> List[List[str]]:
+def _slo_cells(doc: Dict) -> List[str]:
+    """The SLO columns for one process: worst-burning class/metric,
+    its fast-window burn (``!`` = alert active), slow-window budget
+    remaining, canary probe p50, and the engine's attributed FLOP
+    rate (the per-replica cost-rate column)."""
+    s = doc.get("slo") or {}
+    eng = doc.get("engine") or {}
+    worst = s.get("worst") or {}
+    alert = "!" if s.get("alerts_active") else ""
+    cls = worst.get("class")
+    burn = worst.get("fast_burn")
+    return [
+        f"{cls}/{worst.get('metric')}" if cls else "-",
+        (_fmt(burn, ".1f") + alert) if burn is not None else (alert
+                                                              or "-"),
+        _fmt(worst.get("budget_remaining"), ".0%"),
+        _fmt(_pick(s, "canary", "p50_ms"), ".1f"),
+        _fmt(eng.get("cost_flops_per_s"), ".2e"),
+    ]
+
+
+def rows(docs: List[Tuple[str, str, Optional[Dict]]],
+         slo_on: bool = False) -> List[List[str]]:
     out = []
+    ncols = len(header(slo_on))
     for label, ep, doc in docs:
         if doc is None:
-            out.append([label, ep, "DOWN"] + ["-"] * 9)
+            out.append([label, ep, "DOWN"] + ["-"] * (ncols - 3))
             continue
         eng = doc.get("engine") or {}
         g = doc.get("gauges") or {}
@@ -92,7 +119,7 @@ def rows(docs: List[Tuple[str, str, Optional[Dict]]]) -> List[List[str]]:
         p99 = (_pick(eng, "latency_breakdown", "total", "p99_ms")
                or _pick(eng, "latency_breakdown", "decode", "p99_ms")
                or eng.get("p99_ms"))
-        out.append([
+        row = [
             label, ep,
             _fmt(doc.get("pid")),
             _fmt(eng.get("kind") or ("train" if tr.get("steps") else "")),
@@ -108,18 +135,30 @@ def rows(docs: List[Tuple[str, str, Optional[Dict]]]) -> List[List[str]]:
             (f"{_fmt(tr.get('goodput'), '.2f')}/"
              f"{_fmt(tr.get('mfu'), '.3f')}"
              if tr.get("steps") else "-"),
-        ])
+        ]
+        if slo_on:
+            row.extend(_slo_cells(doc))
+        out.append(row)
     return out
 
 
 _HEADER = ["ID", "ENDPOINT", "PID", "KIND", "INFL", "ACTIVE", "CACHE",
            "RATE", "P99MS", "WSTEP", "EPOCH", "GOODPUT/MFU"]
+_SLO_HEADER = ["SLO", "BURN", "BUDGET", "CANP50", "FLOP/S"]
 
 
-def render(table: List[List[str]]) -> str:
-    widths = [max(len(str(r[i])) for r in [_HEADER] + table)
-              for i in range(len(_HEADER))]
-    lines = ["  ".join(h.ljust(w) for h, w in zip(_HEADER, widths))]
+def header(slo_on: bool = False) -> List[str]:
+    """Fleets without an SLO config keep the classic 12-column
+    layout; the SLO columns appear only when some process exports a
+    ``slo`` statusz section."""
+    return _HEADER + _SLO_HEADER if slo_on else _HEADER
+
+
+def render(table: List[List[str]], slo_on: bool = False) -> str:
+    head = header(slo_on)
+    widths = [max(len(str(r[i])) for r in [head] + table)
+              for i in range(len(head))]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(head, widths))]
     for r in table:
         lines.append("  ".join(str(c).ljust(w)
                                for c, w in zip(r, widths)))
@@ -153,7 +192,9 @@ def main(argv=None) -> int:
             up = sum(1 for _, _, d in docs if d is not None)
             print(f"fleet_top  {time.strftime('%H:%M:%S')}  "
                   f"{up}/{len(docs)} up")
-            print(render(rows(docs)))
+            slo_on = any(d is not None and d.get("slo")
+                         for _, _, d in docs)
+            print(render(rows(docs, slo_on), slo_on))
         if not args.watch:
             return 0 if docs and any(d for _, _, d in docs) else 1
         time.sleep(args.watch)

@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""perf_sentinel — the CI gate that catches a perf regression first.
+
+Every ``bench_*`` tool already emits its headline as ONE JSON line:
+
+  {"metric": "serving_decode_throughput", "value": N,
+   "unit": "tokens/s/chip", ...}
+
+This tool turns that shared schema into a regression gate against a
+committed history file (``BENCH_HISTORY.jsonl`` at the repo root —
+one recorded point per line):
+
+  # record a fresh run's points as new baseline history
+  python tools/bench_serving.py --decode ... | tee out.json
+  python tools/perf_sentinel.py --record out.json
+
+  # gate a run: exit 0 when every metric is inside its noise band,
+  # exit 1 naming the first regressed metric
+  python tools/perf_sentinel.py --check out.json
+
+  # show the recorded baselines + noise bands
+  python tools/perf_sentinel.py --list
+
+Noise-aware thresholds: the baseline per metric is the **median** of
+its recorded points and the band is the MAD scaled to a sigma
+(``1.4826 * MAD`` estimates the standard deviation for normal noise).
+A fresh value regresses when it is worse than::
+
+  median  -/+  max(--sigma * 1.4826 * MAD, --rel-floor * |median|)
+
+(the relative floor keeps a 1-point or zero-MAD history from turning
+run-to-run jitter into failures).  Direction comes from the unit:
+rates (``.../s``, ``x``) regress DOWN, latencies (``ms``, ``s``)
+regress UP.  Metrics in the run but not the history pass with a note
+(``--strict`` fails them); history metrics missing from the run are
+ignored (a run benches what it benches).
+
+Input files are scanned line-by-line for JSON objects carrying
+``metric`` + numeric ``value`` — logs and JSON can be interleaved, so
+``bench_* | tee`` output feeds straight in (``-`` reads stdin).
+Stdlib only; never imports the framework.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_HISTORY = os.path.join(_REPO_ROOT, "BENCH_HISTORY.jsonl")
+
+#: JSON keys copied from a bench line into its history record (the
+#: rest of the bench payload is sweep detail, not baseline identity).
+_KEEP_KEYS = ("metric", "value", "unit", "backend", "model")
+
+
+def parse_points(text: str) -> List[Dict]:
+    """Extract ``{"metric": ..., "value": <number>}`` JSON lines."""
+    points = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and isinstance(obj.get("metric"), str) \
+                and isinstance(obj.get("value"), (int, float)) \
+                and not isinstance(obj.get("value"), bool):
+            points.append(obj)
+    return points
+
+
+def read_inputs(paths: List[str]) -> List[Dict]:
+    points = []
+    for p in paths:
+        text = sys.stdin.read() if p == "-" else open(p).read()
+        points.extend(parse_points(text))
+    return points
+
+
+def load_history(path: str) -> List[Dict]:
+    if not os.path.exists(path):
+        return []
+    return parse_points(open(path).read())
+
+
+def lower_is_better(unit: str, metric: str = "") -> bool:
+    """Direction from the unit string: latencies regress UP, rates
+    and ratios regress DOWN."""
+    u = (unit or "").lower()
+    if "/s" in u or u in ("x", "ratio", ""):
+        return False
+    if u.endswith("ms") or u in ("s", "sec", "seconds", "us", "ns"):
+        return True
+    # conservative default: throughput-style higher-is-better
+    return "ms" in u or metric.endswith("_ms")
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def baseline(history: List[Dict], metric: str) -> Optional[Dict]:
+    """Median-of-N + MAD noise stats for one metric (None = no
+    recorded points)."""
+    pts = [h for h in history if h["metric"] == metric]
+    if not pts:
+        return None
+    vals = [float(h["value"]) for h in pts]
+    med = _median(vals)
+    mad = _median([abs(v - med) for v in vals])
+    return {"metric": metric, "median": med, "mad": mad,
+            "n": len(vals), "unit": pts[-1].get("unit", ""),
+            "lower_better": lower_is_better(pts[-1].get("unit", ""),
+                                            metric)}
+
+
+def check_point(pt: Dict, base: Dict, sigma: float,
+                rel_floor: float) -> Dict:
+    """One verdict: {"ok": bool, "why": str, ...} for a fresh point
+    against its baseline stats."""
+    val = float(pt["value"])
+    med, mad = base["median"], base["mad"]
+    band = max(sigma * 1.4826 * mad, rel_floor * abs(med))
+    if base["lower_better"]:
+        ok = val <= med + band
+        delta = val - med
+    else:
+        ok = val >= med - band
+        delta = med - val
+    pct = (delta / abs(med) * 100.0) if med else float("inf")
+    why = (f"{pt['metric']}: {val:g} {base['unit']} vs baseline "
+           f"median {med:g} (n={base['n']}, band ±{band:g}, "
+           f"{'lower' if base['lower_better'] else 'higher'}-better)"
+           + ("" if ok else f" — REGRESSED {pct:+.1f}% past the band"))
+    return {"metric": pt["metric"], "ok": ok, "value": val,
+            "median": med, "band": band, "why": why}
+
+
+def cmd_record(args) -> int:
+    points = read_inputs(args.files)
+    if not points:
+        print("perf_sentinel: no bench JSON lines found in input",
+              file=sys.stderr)
+        return 2
+    with open(args.history, "a") as f:
+        for pt in points:
+            rec = {k: pt[k] for k in _KEEP_KEYS if k in pt}
+            rec["recorded_s"] = round(time.time(), 3)
+            if args.note:
+                rec["note"] = args.note
+            f.write(json.dumps(rec) + "\n")
+    print(f"perf_sentinel: recorded {len(points)} point(s) -> "
+          f"{args.history}")
+    for pt in points:
+        print(f"  {pt['metric']} = {pt['value']:g} "
+              f"{pt.get('unit', '')}")
+    return 0
+
+
+def cmd_check(args) -> int:
+    points = read_inputs(args.files)
+    if not points:
+        print("perf_sentinel: no bench JSON lines found in input",
+              file=sys.stderr)
+        return 2
+    history = load_history(args.history)
+    failures, unknown = [], []
+    for pt in points:
+        base = baseline(history, pt["metric"])
+        if base is None:
+            unknown.append(pt["metric"])
+            print(f"NEW   {pt['metric']} = {pt['value']:g} "
+                  f"{pt.get('unit', '')} (no recorded baseline)")
+            continue
+        verdict = check_point(pt, base, args.sigma, args.rel_floor)
+        print(("PASS  " if verdict["ok"] else "FAIL  ")
+              + verdict["why"])
+        if not verdict["ok"]:
+            failures.append(verdict)
+    if failures:
+        print(f"perf_sentinel: {len(failures)} regression(s): "
+              + ", ".join(v["metric"] for v in failures),
+              file=sys.stderr)
+        return 1
+    if unknown and args.strict:
+        print("perf_sentinel: --strict and no baseline for: "
+              + ", ".join(unknown), file=sys.stderr)
+        return 1
+    print(f"perf_sentinel: {len(points)} metric(s) within the "
+          f"noise band")
+    return 0
+
+
+def cmd_list(args) -> int:
+    history = load_history(args.history)
+    if not history:
+        print(f"perf_sentinel: no history at {args.history}")
+        return 0
+    for metric in sorted({h["metric"] for h in history}):
+        b = baseline(history, metric)
+        print(f"{metric}: median {b['median']:g} {b['unit']} "
+              f"(n={b['n']}, MAD {b['mad']:g}, "
+              f"{'lower' if b['lower_better'] else 'higher'}-better)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--record", action="store_true",
+                      help="append the input's bench points to history")
+    mode.add_argument("--check", action="store_true",
+                      help="gate the input against the history; "
+                           "exit 1 on regression")
+    mode.add_argument("--list", action="store_true",
+                      help="show recorded baselines + noise bands")
+    ap.add_argument("files", nargs="*",
+                    help="bench output files ('-' = stdin); logs and "
+                         "JSON may be interleaved")
+    ap.add_argument("--history", default=DEFAULT_HISTORY,
+                    help=f"history JSONL (default {DEFAULT_HISTORY})")
+    ap.add_argument("--sigma", type=float, default=5.0,
+                    help="MAD multiples of allowed noise (default 5)")
+    ap.add_argument("--rel-floor", type=float, default=0.10,
+                    help="minimum band as a fraction of the median "
+                         "(default 0.10)")
+    ap.add_argument("--strict", action="store_true",
+                    help="--check fails metrics with no baseline")
+    ap.add_argument("--note", default="",
+                    help="--record: annotation stored on each point")
+    args = ap.parse_args(argv)
+    if args.list:
+        return cmd_list(args)
+    if not args.files:
+        ap.error("--record/--check need input files (or '-')")
+    return cmd_record(args) if args.record else cmd_check(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
