@@ -26,6 +26,13 @@ env point                               effect
                                         (half the bytes, then the socket
                                         dies) — exercises the bounded
                                         reconnect path.
+``MXNET_CHAOS_MIGRATION_TEAR=<n>``      the ``n``-th disaggregated KV
+                                        page-migration frame this
+                                        process forwards is torn
+                                        mid-send (half the bytes, then
+                                        the socket dies) — the ticket
+                                        must resolve exactly-once via
+                                        re-prefill.
 ``MXNET_CHAOS_SLOW_RANK=<s>``           sleep ``s`` seconds at every fit
                                         step AND every serving decode
                                         step (straggler / slow-replica
@@ -53,8 +60,8 @@ __all__ = ["Chaos", "get_chaos", "reset_chaos"]
 
 _VARS = ("MXNET_CHAOS_KILL_STEP", "MXNET_CHAOS_DEAD_RANK_STEP",
          "MXNET_CHAOS_DEAD_RANKS", "MXNET_CHAOS_HEARTBEAT_STALL",
-         "MXNET_CHAOS_TORN_SOCKET", "MXNET_CHAOS_SLOW_RANK",
-         "MXNET_CHAOS_RANK")
+         "MXNET_CHAOS_TORN_SOCKET", "MXNET_CHAOS_MIGRATION_TEAR",
+         "MXNET_CHAOS_SLOW_RANK", "MXNET_CHAOS_RANK")
 
 
 class Chaos:
@@ -81,19 +88,22 @@ class Chaos:
             "MXNET_CHAOS_HEARTBEAT_STALL", minimum=0.0)
         self.torn_socket = _validated_env("MXNET_CHAOS_TORN_SOCKET",
                                           minimum=1)
+        self.migration_tear = _validated_env("MXNET_CHAOS_MIGRATION_TEAR",
+                                             minimum=1)
         self.slow_rank = _validated_env("MXNET_CHAOS_SLOW_RANK",
                                         minimum=0.0)
         self.rank_filter = _validated_env("MXNET_CHAOS_RANK", minimum=0)
         self._dead_rank_fired = False
         self._stall_fired = False
         self._frames_sent = 0
+        self._mig_frames = 0
         self._log = logging.getLogger("mxnet_tpu.chaos")
 
     @property
     def armed(self) -> bool:
         return any(v is not None for v in (
             self.kill_step, self.dead_rank_step, self.heartbeat_stall,
-            self.torn_socket, self.slow_rank))
+            self.torn_socket, self.migration_tear, self.slow_rank))
 
     def _applies(self, rank: Optional[int]) -> bool:
         return (self.rank_filter is None or rank is None
@@ -174,6 +184,36 @@ class Chaos:
             sock.close()
         except OSError:
             pass
+        return True
+
+    def torn_migration_send(self, sock, frame: bytes) -> bool:
+        """Tear the Nth KV page-migration frame mid-send: ship the
+        length header plus HALF the body, then kill the socket.  The
+        decode replica discards the torn frame; the router's
+        exactly-once ticket latch must resolve the stream through the
+        re-prefill retry path without a duplicate or a loss.  Returns
+        True when the fault fired (the caller treats the send as a
+        transport death)."""
+        if self.migration_tear is None:
+            return False
+        self._mig_frames += 1
+        if self._mig_frames != self.migration_tear:
+            return False
+        self._log.warning(
+            "[chaos] MXNET_CHAOS_MIGRATION_TEAR=%d firing: tearing "
+            "migration frame mid-send", self.migration_tear)
+        from . import wire
+
+        try:
+            sock.sendall(wire.U32.pack(len(frame))
+                         + frame[:max(1, len(frame) // 2)])
+        except OSError:
+            pass
+        for fn in (lambda: sock.shutdown(2), sock.close):
+            try:
+                fn()
+            except OSError:
+                pass
         return True
 
 

@@ -292,6 +292,13 @@ register_env(
     "the bytes, then the socket dies) — exercises the bounded "
     "reconnect.  NEVER set in production.")
 register_env(
+    "MXNET_CHAOS_MIGRATION_TEAR", None, int,
+    "CHAOS: tear the N-th disaggregated KV page-migration frame "
+    "mid-send (length header + half the body, then the socket dies) — "
+    "the decode replica discards the torn frame and the router must "
+    "resolve the stream exactly-once through re-prefill.  NEVER set "
+    "in production.")
+register_env(
     "MXNET_CHAOS_SLOW_RANK", None, float,
     "CHAOS: sleep S seconds at every fit step AND every serving "
     "decode step (straggler / slow-replica fault — the SLO engine's "
@@ -568,6 +575,31 @@ register_env(
     "swap (the replica resumes on its old weights; replicas already "
     "swapped stay swapped).  Must be >= 0.1; garbage raises at Router "
     "construction.")
+register_env(
+    "MXNET_FLEET_ROLES", "", str,
+    "CSV of disaggregated replica roles (prefill|decode|mixed), one "
+    "token per replica in rid order — e.g. 'prefill,decode,decode'.  "
+    "Prefill-role replicas run admission + chunked/prefix-shared "
+    "prefill only and export the stream's KV pages as a signed page "
+    "frame; the Router forwards the frame to a decode-role replica "
+    "where decode continues bit-identically.  Empty (default): roles "
+    "off, every replica serves both phases.  Unknown tokens, a count "
+    "mismatch, or a one-sided split (prefill without decode or vice "
+    "versa) raise at Router construction.")
+register_env(
+    "MXNET_FLEET_AUTOSCALE", 0, int,
+    "1: the Router re-balances the prefill/decode role split from its "
+    "own telemetry (queue depth and in-flight work per role weighted "
+    "by the learned cost EMAs, decode cache_util, interactive SLO "
+    "burn-rates) — one drain->flip->warmup per evaluation, 2x "
+    "hysteresis, never stripping the last replica of a role.  Only "
+    "meaningful with MXNET_FLEET_ROLES set.  0 (default): the split "
+    "is static (Router.set_role / autoscale_once remain callable).  "
+    "Garbage raises at Router construction.")
+register_env(
+    "MXNET_FLEET_AUTOSCALE_INTERVAL", 5.0, float,
+    "Seconds between autoscaler evaluations of the prefill/decode "
+    "role split.  Must be > 0; garbage raises at Router construction.")
 register_env(
     "MXNET_METRICS_PORT", 0, int,
     "Port of the per-process ops HTTP endpoint serving /metrics "

@@ -80,7 +80,13 @@ __all__ = ["Router", "FleetClient", "ShedError", "ReplicaClient",
 
 # fleet wire ops (a separate op space from ps.py: different servers,
 # same framing)
-(_F_SUBMIT, _F_RESULT, _F_CTRL, _F_CTRL_RESULT) = range(101, 105)
+(_F_SUBMIT, _F_RESULT, _F_CTRL, _F_CTRL_RESULT,
+ _F_MIGRATE) = range(101, 106)
+
+# disaggregated-serving replica roles ("mixed" = the classic
+# do-everything replica); the fleet is DISAGGREGATED the moment both
+# specialized roles are present
+REPLICA_ROLES = ("prefill", "decode", "mixed")
 
 # result status bytes
 _ST_OK, _ST_ERR, _ST_SHED = 0, 1, 2
@@ -115,8 +121,34 @@ def fleet_env(name: str):
     minima = {"MXNET_FLEET_REPLICAS": 1,
               "MXNET_FLEET_SHED_DEADLINE_MS": 0.0,
               "MXNET_FLEET_RETRY_BUDGET": 0,
-              "MXNET_FLEET_SWAP_DRAIN_TIMEOUT": 0.1}
+              "MXNET_FLEET_SWAP_DRAIN_TIMEOUT": 0.1,
+              "MXNET_FLEET_AUTOSCALE": 0,
+              "MXNET_FLEET_AUTOSCALE_INTERVAL": 0.05}
     return _validated_env(name, minimum=minima[name])
+
+
+def roles_env() -> Optional[List[str]]:
+    """``MXNET_FLEET_ROLES`` — comma-separated initial role per replica
+    (by rid order), e.g. ``prefill,decode,decode``.  Empty/unset =
+    roles never enabled (the classic mixed fleet).  Garbage raises at
+    construction, and a split that names one specialized role without
+    its counterpart is refused: a prefill-only fleet can never decode,
+    and vice versa."""
+    raw = os.environ.get("MXNET_FLEET_ROLES", "").strip()
+    if not raw:
+        return None
+    roles = [tok.strip() for tok in raw.split(",")]
+    for tok in roles:
+        if tok not in REPLICA_ROLES:
+            raise MXNetError(
+                f"MXNET_FLEET_ROLES={raw!r}: role {tok!r} must be one "
+                f"of {REPLICA_ROLES}")
+    if ("prefill" in roles) != ("decode" in roles):
+        raise MXNetError(
+            f"MXNET_FLEET_ROLES={raw!r}: a disaggregated fleet needs "
+            "BOTH a prefill and a decode role (or neither) — a "
+            "one-sided split cannot serve a single request end to end")
+    return roles
 
 
 # ---------------------------------------------------------------------------
@@ -144,6 +176,9 @@ def _pack_spec(spec: Dict[str, Any]) -> bytes:
         eos = spec.get("eos")
         body += wire.I64.pack(_NO_EOS if eos is None else int(eos))
         body += wire.U64.pack(int(spec.get("seed", 0)))
+        # disagg phase byte: 0 = classic end-to-end decode, 1 =
+        # prefill-export (the response is a signed KV page frame)
+        body += struct.pack("!B", 1 if spec.get("phase") == 1 else 0)
         body += wire.pack_tensor(
             np.asarray(spec["prompt"], dtype=np.int32))
         return bytes(body)
@@ -171,12 +206,14 @@ def _unpack_spec(buf: memoryview, off: int) -> Dict[str, Any]:
         off += 8
         (seed,) = wire.U64.unpack_from(buf, off)
         off += 8
+        phase = buf[off]
+        off += 1
         prompt, off = wire.unpack_tensor(buf, off)
         return {"kind": "decode", "prompt": np.array(prompt),
                 "max_new": int(max_new),
                 "temperature": None if temp < 0 else float(temp),
                 "eos": None if eos == _NO_EOS else int(eos),
-                "seed": int(seed)}
+                "seed": int(seed), "phase": int(phase)}
     raise MXNetError(f"unknown wire request kind {kind}")
 
 
@@ -230,10 +267,15 @@ class _Duplex:
     def start(self):
         self._reader.start()
 
-    def begin(self, op: int, body: bytes, parse) -> Future:
+    def begin(self, op: int, body: bytes, parse, tear=None) -> Future:
         """Send ``op | req_id | body``; the Future resolves with
         ``parse(status, payload_view)`` when the matching response
-        arrives.  A dead connection fails ALL outstanding futures."""
+        arrives.  A dead connection fails ALL outstanding futures.
+
+        ``tear``: optional chaos hook ``tear(sock, frame) -> bool`` —
+        when it returns True it has destroyed the connection mid-frame
+        (half the bytes sent, socket shut down); the send is treated
+        as a transport death, exactly like a peer crashing mid-write."""
         fut: Future = Future()
         with self._lock:
             if self._dead is not None:
@@ -247,6 +289,9 @@ class _Duplex:
         frame = bytes([op]) + wire.U64.pack(rid) + body
         try:
             with self._wlock:
+                if tear is not None and tear(self._sock, frame):
+                    raise ConnectionError(
+                        "chaos: migration frame torn mid-send")
                 wire.send_frame(self._sock, frame)
         except BaseException as exc:
             self._poison(exc)
@@ -317,6 +362,24 @@ def _parse_submit_response(status: int, payload: memoryview):
         head, _, detail = msg.partition(":")
         return ShedError(detail.strip() or msg, reason=head or "deadline")
     return MXNetError(msg)
+
+
+def _make_page_frame_parser(secret: bytes):
+    """Response parser for a phase-1 (prefill-export) submit: the ok
+    payload is one signed KV page frame.  The router verifies it here
+    and keeps the RAW bytes too — the forward to the decode replica
+    ships the already-signed frame verbatim (same fleet secret), so a
+    megabyte of page slabs is never re-encoded in the hot handoff."""
+
+    def parse(status: int, payload: memoryview):
+        if status == _ST_OK:
+            frame = bytes(payload)
+            meta, arrays = wire.unpack_page_frame(
+                secret, memoryview(frame), "migration frame (prefill)")
+            return {"meta": meta, "arrays": arrays, "frame": frame}
+        return _parse_submit_response(status, payload)
+
+    return parse
 
 
 # ---------------------------------------------------------------------------
@@ -392,9 +455,16 @@ class ReplicaServer:
                         "wire.recv", trace.child(), cat="fleet",
                         args={"rid": self.rid})
                 spec = _unpack_spec(buf, off)
+                prefill = spec["kind"] == "decode" and spec.get("phase")
                 if spec["kind"] == "infer":
                     fut = self.harness.submit_infer(spec["inputs"],
                                                     trace=trace)
+                elif prefill:
+                    fut = self.harness.submit_prefill_export(
+                        spec["prompt"], spec["max_new"],
+                        temperature=spec["temperature"],
+                        eos_id=spec["eos"], seed=spec["seed"],
+                        trace=trace)
                 else:
                     fut = self.harness.submit_decode(
                         spec["prompt"], spec["max_new"],
@@ -406,7 +476,43 @@ class ReplicaServer:
                            f"{type(exc).__name__}: {exc}".encode())
                 return
 
-            def done(f, _rid=rid):
+            def done(f, _rid=rid, _prefill=prefill):
+                exc = f.exception()
+                if exc is not None:
+                    self._send(sock, wlock, _F_RESULT, _rid, _ST_ERR,
+                               f"{type(exc).__name__}: {exc}".encode())
+                elif _prefill:
+                    # the result is a migration payload: sign it whole
+                    # (meta AND slabs) — the router forwards these
+                    # bytes verbatim to the decode-role replica
+                    pay = f.result()
+                    self._send(sock, wlock, _F_RESULT, _rid, _ST_OK,
+                               wire.pack_page_frame(
+                                   self._secret, pay["meta"],
+                                   pay["kv_arrays"]))
+                else:
+                    self._send(sock, wlock, _F_RESULT, _rid, _ST_OK,
+                               _pack_result(f.result()))
+
+            fut.add_done_callback(done)
+            return
+        if op == _F_MIGRATE:
+            try:
+                trace, off = wire.unpack_trace(buf, 9)
+                if trace is not None:
+                    profiler.trace_point(
+                        "wire.recv", trace.child(), cat="fleet",
+                        args={"rid": self.rid, "op": "migrate"})
+                meta, arrays = wire.unpack_page_frame(
+                    self._secret, buf[off:], "migration frame (import)")
+                fut = self.harness.submit_import(meta, arrays,
+                                                 trace=trace)
+            except BaseException as exc:  # noqa: BLE001 — to the wire
+                self._send(sock, wlock, _F_RESULT, rid, _ST_ERR,
+                           f"{type(exc).__name__}: {exc}".encode())
+                return
+
+            def mig_done(f, _rid=rid):
                 exc = f.exception()
                 if exc is not None:
                     self._send(sock, wlock, _F_RESULT, _rid, _ST_ERR,
@@ -415,7 +521,7 @@ class ReplicaServer:
                     self._send(sock, wlock, _F_RESULT, _rid, _ST_OK,
                                _pack_result(f.result()))
 
-            fut.add_done_callback(done)
+            fut.add_done_callback(mig_done)
             return
         if op == _F_CTRL:
             try:
@@ -451,6 +557,9 @@ class ReplicaServer:
                 out = self.harness.swap(
                     spec["ckpt_dir"],
                     drain_timeout=float(spec.get("drain_timeout", 60.0)))
+            elif op == "role":
+                self.harness.set_role(spec["role"])
+                out = {"ok": True, "role": spec["role"]}
             elif op == "stop":
                 out = {"ok": True}
                 self._closing.set()
@@ -513,15 +622,46 @@ class ReplicaClient:
         trace = spec.get("trace")
         if trace is not None:
             spec = {k: v for k, v in spec.items() if k != "trace"}
+        if spec["kind"] == "migrate":
+            return self._submit_migrate(spec, trace)
         body = wire.pack_trace(trace) + _pack_spec(spec)
+        parse = (_make_page_frame_parser(self._secret)
+                 if spec["kind"] == "decode" and spec.get("phase")
+                 else _parse_submit_response)
         t0 = time.perf_counter()
-        fut = self._dx.begin(_F_SUBMIT, body, _parse_submit_response)
+        fut = self._dx.begin(_F_SUBMIT, body, parse)
         if trace is not None:
             profiler.add_trace_event(
                 "wire.send", t0, time.perf_counter() - t0,
                 trace.child(), cat="fleet",
                 args={"rid": self.rid, "bytes": len(body)})
         return fut
+
+    def _submit_migrate(self, spec: Dict[str, Any], trace) -> Future:
+        """Phase 2: forward the prefill replica's already-signed page
+        frame to this (decode-role) replica.  The Future resolves to
+        the FULL generated token list once the migrated stream retires
+        there.  ``MXNET_CHAOS_MIGRATION_TEAR`` hooks THIS send — the
+        drill tears the Nth migration frame mid-flight and the ticket
+        must resolve through the exactly-once retry (re-prefill)."""
+        from . import chaos as _chaos
+
+        body = wire.pack_trace(trace) + spec["frame"]
+        t0 = time.perf_counter()
+        ch = _chaos.get_chaos()
+        tear = ch.torn_migration_send if ch is not None else None
+        fut = self._dx.begin(_F_MIGRATE, body, _parse_submit_response,
+                             tear=tear)
+        if trace is not None:
+            profiler.add_trace_event(
+                "wire.send", t0, time.perf_counter() - t0,
+                trace.child(), cat="fleet",
+                args={"rid": self.rid, "bytes": len(body),
+                      "op": "migrate"})
+        return fut
+
+    def set_role(self, role: str) -> Dict:
+        return self._ctrl({"op": "role", "role": role})
 
     def _ctrl(self, obj: Dict, timeout: float = 120.0) -> Dict:
         def parse(status, payload):
@@ -703,7 +843,9 @@ class _Ticket:
     __slots__ = ("tid", "spec", "deadline", "units", "attempts",
                  "rid", "t_submit", "t_dispatch", "future", "delivered",
                  "queued", "trace", "t_enqueue", "tp_submit",
-                 "tp_dispatch", "trace_owned", "slo_class", "canary")
+                 "tp_dispatch", "trace_owned", "slo_class", "canary",
+                 "phase", "spec0", "failures", "prefill_rid",
+                 "tp_prefill_done", "mig_pages")
 
     def __init__(self, tid, spec, deadline, units, future, trace=None,
                  slo_class="interactive", canary=False):
@@ -727,10 +869,22 @@ class _Ticket:
         self.trace_owned = False  # router created the root span
         self.slo_class = slo_class  # validated at _accept()
         self.canary = canary        # excluded from request counters
+        # disaggregated serving: 0 = classic end-to-end dispatch,
+        # 1 = prefill-export in flight, 2 = page migration / decode
+        # continuation in flight.  ANY retry resets to 1 with spec0
+        # (decode death re-prefills; prefill death retries prefill).
+        self.phase = 0
+        self.spec0 = None             # pristine spec for phase resets
+        self.failures = 0             # replica failures (retry budget)
+        self.prefill_rid = None       # who ran phase 1 (migration edge)
+        self.tp_prefill_done = 0.0    # phase-1 completion (disagg TTFT)
+        self.mig_pages = 0            # pages riding the phase-2 frame
 
 
 class _ReplicaState:
-    __slots__ = ("handle", "outstanding", "draining", "dead", "swaps")
+    __slots__ = ("handle", "outstanding", "draining", "dead", "swaps",
+                 "role", "free_blocks", "kv_block", "cache_util",
+                 "role_flips")
 
     def __init__(self, handle):
         self.handle = handle
@@ -738,6 +892,14 @@ class _ReplicaState:
         self.draining = False
         self.dead = False
         self.swaps = 0
+        self.role = "mixed"           # disagg role (roles off = mixed)
+        # decode-capacity ledger: refreshed from handle.stats() by the
+        # monitor loop, decremented optimistically at phase-2 dispatch.
+        # None = never measured → admit and measure (the PR-1 rule).
+        self.free_blocks: Optional[int] = None
+        self.kv_block: Optional[int] = None
+        self.cache_util: Optional[float] = None
+        self.role_flips = 0
 
 
 class Router:
@@ -778,7 +940,9 @@ class Router:
                  secret: bytes = b"", retry_budget: Optional[int] = None,
                  default_deadline_ms: Optional[float] = None,
                  replica_depth: int = 8, max_pending: int = 1024,
-                 dead_timeout: Optional[float] = None):
+                 dead_timeout: Optional[float] = None,
+                 roles: Optional[Sequence[str]] = None,
+                 autoscale: Optional[bool] = None):
         if not replicas:
             raise MXNetError("Router needs at least one replica")
         self._fleet_dir = fleet_dir
@@ -807,6 +971,36 @@ class Router:
             cb = getattr(h, "set_on_death", None)
             if cb is not None:
                 cb(lambda exc, _rid=rid: self._replica_failed(_rid, exc))
+        # disaggregated prefill/decode roles: kwarg wins, else the
+        # MXNET_FLEET_ROLES split (by rid order), else roles stay off
+        role_list = list(roles) if roles is not None else roles_env()
+        self._roles_on = role_list is not None
+        if role_list is not None:
+            rids = sorted(self._replicas)
+            if len(role_list) != len(rids):
+                raise MXNetError(
+                    f"{len(role_list)} role(s) for {len(rids)} "
+                    f"replica(s) — the role split must name every "
+                    f"replica (rid order: {rids})")
+            for role in role_list:
+                if role not in REPLICA_ROLES:
+                    raise MXNetError(
+                        f"replica role {role!r} must be one of "
+                        f"{REPLICA_ROLES}")
+            if ("prefill" in role_list) != ("decode" in role_list):
+                raise MXNetError(
+                    "a disaggregated fleet needs BOTH a prefill and a "
+                    "decode role (or neither)")
+            for rid, role in zip(rids, role_list):
+                state = self._replicas[rid]
+                state.role = role
+                if role != "mixed":
+                    setter = getattr(state.handle, "set_role", None)
+                    if setter is None:
+                        raise MXNetError(
+                            f"replica {rid} handle has no set_role() — "
+                            "it cannot take a disaggregated role")
+                    setter(role)
         self._pending: List[_Ticket] = []
         self._next_tid = 0
         self._alive = True
@@ -836,6 +1030,19 @@ class Router:
             target=self._monitor_loop, daemon=True,
             name="mxnet_tpu-fleet-monitor")
         self._monitor.start()
+        # role autoscaler: periodically re-evaluate the prefill/decode
+        # split from live telemetry (queue depths, cache_util ledger,
+        # per-kind cost EMAs) — MXNET_FLEET_AUTOSCALE gates the thread;
+        # autoscale_once() stays callable for deterministic drills
+        self._autoscale_on = bool(
+            int(fleet_env("MXNET_FLEET_AUTOSCALE"))
+            if autoscale is None else autoscale)
+        self._autoscale_interval = float(
+            fleet_env("MXNET_FLEET_AUTOSCALE_INTERVAL"))
+        if self._autoscale_on and self._roles_on:
+            threading.Thread(
+                target=self._autoscale_loop, daemon=True,
+                name="mxnet_tpu-fleet-autoscale").start()
         self._set_alive_gauge()
         # ops surface: /statusz grows a router section; the HTTP
         # endpoint itself is MXNET_METRICS_PORT-gated
@@ -974,6 +1181,44 @@ class Router:
         return total + est
 
     # -- dispatch -------------------------------------------------------
+    def _disagg_live(self) -> bool:
+        """Both specialized roles present among live, non-draining
+        replicas (lock held).  When one side is gone — died, or all
+        flipped away — the fleet degrades to classic mixed routing
+        instead of wedging."""
+        if not self._roles_on:
+            return False
+        has_p = has_d = False
+        for s in self._replicas.values():
+            if s.dead or s.draining:
+                continue
+            has_p = has_p or s.role == "prefill"
+            has_d = has_d or s.role in ("decode", "mixed")
+        return has_p and has_d
+
+    def _decode_room(self, need_blocks: int) -> bool:
+        """Role-aware admission (lock held): does SOME decode-capable
+        replica have room for this stream's eventual KV pages?  An
+        unmeasured ledger admits (measure instead of assume)."""
+        for s in self._replicas.values():
+            if s.dead or s.draining or s.role == "prefill":
+                continue
+            if s.free_blocks is None or s.free_blocks >= need_blocks:
+                return True
+        return False
+
+    def _need_blocks(self, t: _Ticket, kv_block: Optional[int]) -> int:
+        """Worst-case pages a decode ticket will hold: prompt+max_new
+        over the page grid (phase-2 tickets carry the exact count)."""
+        if t.phase == 2:
+            return t.mig_pages
+        if not kv_block:
+            return 0  # page size never measured → gate on nothing
+        spec = t.spec0 if t.spec0 is not None else t.spec
+        tokens = int(np.asarray(spec["prompt"]).size) \
+            + int(spec["max_new"])
+        return -(-tokens // int(kv_block))
+
     def _eligible(self, t: _Ticket):
         """(best replica or None, provably_unmeetable) under the lock.
 
@@ -982,7 +1227,15 @@ class Router:
         that is merely at depth (can't take the ticket NOW but could
         meet the deadline once a slot frees) keeps the request
         admitted, and any unmeasured bucket makes nothing provable
-        (the PR-1 rule: explore/measure instead of assume)."""
+        (the PR-1 rule: explore/measure instead of assume).
+
+        Disaggregated routing (both roles live): fresh decode work
+        lands on prefill-role or mixed replicas, phase-2 migrations
+        land on decode-role or mixed replicas WITH free pool pages for
+        the spliced stream, and a prefill-role replica only takes a
+        fresh stream when some decode-capable replica has room for its
+        eventual pages — admission keys on free decode blocks on the
+        TARGET role, not just queue depth."""
         best, best_wait = None, None
         provable = t.deadline is not None
         meetable = False  # some live replica could finish in time
@@ -994,9 +1247,23 @@ class Router:
         # pile work onto whichever replica holds unmeasured requests)
         fallback = (sum(self._cost.values()) / len(self._cost)
                     if self._cost else 1.0)
+        disagg = t.spec["kind"] != "infer" and self._disagg_live()
         for state in self._replicas.values():
             if state.dead or state.draining:
                 continue
+            if disagg:
+                if t.phase == 2:
+                    if state.role == "prefill":
+                        continue  # pages splice into DECODE pools
+                    if state.free_blocks is not None \
+                            and state.free_blocks < t.mig_pages:
+                        continue  # no room to splice (yet)
+                else:
+                    if state.role == "decode":
+                        continue  # fresh prefills stay off decoders
+                    if state.role == "prefill" and not self._decode_room(
+                            self._need_blocks(t, state.kv_block)):
+                        continue  # prefilling now would strand the KV
             wait = self._predicted_wait_ms(state, t)
             if wait is None:
                 provable = False  # unmeasured bucket: admit, measure
@@ -1080,6 +1347,34 @@ class Router:
                     t.attempts += 1
                     t.t_dispatch = time.monotonic()
                     now_p = t.tp_dispatch = time.perf_counter()
+                    if t.spec["kind"] == "decode":
+                        # phase is decided by the TARGET's role: a
+                        # prefill-role replica runs phase 1 (export
+                        # after TTFT); a mixed replica runs the classic
+                        # end-to-end decode even on a re-dispatch
+                        if state.role == "prefill":
+                            if t.spec0 is None:
+                                t.spec0 = dict(t.spec)
+                            t.phase = 1
+                            t.spec = dict(t.spec0)
+                            t.spec["phase"] = 1
+                        elif t.spec0 is not None:
+                            t.phase = 0
+                            t.spec = dict(t.spec0)
+                    elif t.phase == 2:
+                        # page splice: burn the target's block ledger
+                        # optimistically (the monitor re-measures) and
+                        # book the migration window — export + handoff
+                        # queue — the instant the pages leave limbo
+                        if state.free_blocks is not None:
+                            state.free_blocks = max(
+                                0, state.free_blocks - t.mig_pages)
+                        mig_ms = (now_p - t.tp_prefill_done) * 1e3 \
+                            + float(t.spec.get("meta", {})
+                                    .get("export_ms", 0.0))
+                        self._metrics.observe("migration_ms", mig_ms)
+                        profiler.observe("fleet.migration_ms", mig_ms)
+                        self._count("migration_ms_total", mig_ms)
                     wait_ms = (now_p - t.t_enqueue) * 1e3
                     self._metrics.observe("queue_wait_ms", wait_ms)
                     profiler.observe("fleet.queue_wait_ms", wait_ms)
@@ -1100,14 +1395,14 @@ class Router:
                     profiler.set_gauge(
                         f"fleet.queue_depth.r{t.rid}",
                         len(state.outstanding))
-                    todo.append((t, state.handle, t.attempts))
+                    todo.append((t, state.handle, t.attempts, t.phase))
                 profiler.set_gauge("fleet.pending", len(self._pending))
                 if not todo and self._pending:
                     # head can't be placed (fleet at depth / draining):
                     # wait for a completion to free a slot instead of
                     # spinning the shed/assign scan at 100% CPU
                     self._cond.wait(timeout=0.05)
-            for t, handle, attempt in todo:
+            for t, handle, attempt, phase in todo:
                 # the replica sees the ticket's trace context as its
                 # parent ("trace" rides the spec to ReplicaClient,
                 # which ships it as the wire's optional field;
@@ -1119,8 +1414,8 @@ class Router:
                     self._replica_failed(handle.rid, exc)
                     continue
                 rfut.add_done_callback(
-                    lambda f, _t=t, _a=attempt, _r=handle.rid:
-                    self._on_done(_t, f, _a, _r))
+                    lambda f, _t=t, _a=attempt, _r=handle.rid, _p=phase:
+                    self._on_done(_t, f, _a, _r, _p))
 
     def _shed_locked(self, t: _Ticket, reason: str, detail: str):
         t.delivered = True
@@ -1185,15 +1480,36 @@ class Router:
                           "error": str(why)[:200]})
 
     # -- completion -----------------------------------------------------
+    def _reset_phase_locked(self, t: _Ticket):
+        """ANY retry of a disagg ticket restarts from phase 1 with the
+        pristine spec: a dead decode replica's spliced pages are gone
+        (re-prefill — the same recompute path preemption uses) and a
+        dead prefill replica's frame never materialized."""
+        if t.spec0 is not None:
+            if t.phase == 2:
+                self._count("re_prefills")
+            t.phase = 0  # the next dispatch's target role re-decides
+            t.spec = dict(t.spec0)
+            t.mig_pages = 0
+            t.tp_prefill_done = 0.0
+            t.prefill_rid = None
+
     def _on_done(self, t: _Ticket, rfut: Future, attempt: int,
-                 rid_disp: int):
+                 rid_disp: int, phase_disp: int = 0):
         """A replica's future resolved for dispatch #``attempt`` of
         this ticket.  Exactly-once lives here: the ``delivered`` latch
         retires the ticket on FIRST delivery; a late/stale completion
         (the ticket was already retried elsewhere, or already answered)
-        is dropped, never double-delivered and never double-retried."""
+        is dropped, never double-delivered and never double-retried.
+
+        ``phase_disp`` is the phase THIS dispatch ran: a phase-1
+        success is not a delivery — it converts the ticket into a
+        phase-2 page migration and front-requeues it (the stream is
+        past its prefill; the splice must not wait behind fresh
+        admissions)."""
         exc = rfut.exception()
         retry = False
+        override = None
         with self._cond:
             current = (t.attempts == attempt)
             if current:
@@ -1210,7 +1526,58 @@ class Router:
                 self._count("duplicates")
                 self._cond.notify_all()
                 return
-            if exc is None:
+            if exc is None and phase_disp == 1:
+                if not current or t.queued:
+                    # a stale page frame (the live attempt re-prefills
+                    # or already moved on): splicing it ANYWHERE could
+                    # race the live stream — drop it, exactly once
+                    self._count("duplicates")
+                    self._cond.notify_all()
+                    return
+                res = rfut.result()
+                meta = res["meta"]
+                now_p = time.perf_counter()
+                t.tp_prefill_done = now_p
+                t.prefill_rid = rid_disp
+                self._observe_cost(
+                    t, (time.monotonic() - t.t_dispatch) * 1e3)
+                # disaggregated TTFT: the first token exists the
+                # moment prefill completes — the decode tail can no
+                # longer move this number
+                ttft = (now_p - t.tp_submit) * 1e3
+                self._metrics.observe("ttft_ms", ttft)
+                profiler.observe("fleet.ttft_ms", ttft)
+                if meta.get("done"):
+                    # finished at prefill (max_new == 1 / instant
+                    # eos): nothing to migrate — deliver directly
+                    t.delivered = True
+                    override = [np.asarray(res["arrays"][1], np.int32)]
+                else:
+                    t.phase = 2
+                    t.mig_pages = int(meta.get("n_pages", 0))
+                    t.spec = {"kind": "migrate", "meta": meta,
+                              "frame": res.get("frame"),
+                              "arrays": res.get("arrays")}
+                    t.queued = True
+                    t.t_enqueue = now_p
+                    self._pending.insert(0, t)
+                    nbytes = int(meta.get("migration_bytes", 0))
+                    self._count("migrations")
+                    self._count("migration_bytes", nbytes)
+                    if t.trace is not None:
+                        # the migration edge of the span tree: ties
+                        # the prefill replica's migrate_out to the
+                        # decode replica's migrate_in across processes
+                        profiler.trace_point(
+                            "router.migrate", t.trace.child(),
+                            cat="fleet",
+                            args={"tid": t.tid,
+                                  "from_rid": rid_disp,
+                                  "pages": t.mig_pages,
+                                  "bytes": nbytes})
+                    self._cond.notify_all()
+                    return
+            elif exc is None:
                 # even a STALE success delivers (the convicted replica
                 # answered after all — first answer wins; the live
                 # retry's answer will hit the latch above).  If
@@ -1234,9 +1601,11 @@ class Router:
                 self._cond.notify_all()
                 return
             elif self._is_replica_failure(exc):
-                if t.attempts <= self._retry_budget:
+                t.failures += 1
+                if t.failures <= self._retry_budget:
                     retry = True
                     t.queued = True
+                    self._reset_phase_locked(t)
                     self._requeue_retry_locked(t, rid_disp, str(exc))
                 else:
                     t.delivered = True
@@ -1269,13 +1638,23 @@ class Router:
             profiler.trace_point(
                 "router.deliver", t.trace.child(), cat="fleet",
                 args={"tid": t.tid, "ok": exc is None})
+        if exc is None and t.tp_prefill_done:
+            # disagg decode tail: per-token latency AFTER the handoff
+            # (the number the prefill/decode isolation bench bounds)
+            res_peek = rfut.result() if override is None else override
+            toks = res_peek[0] if isinstance(res_peek, (list, tuple)) \
+                else res_peek
+            n = max(1, int(np.asarray(toks).size) - 1)
+            dms = ((time.perf_counter() - t.tp_prefill_done) * 1e3) / n
+            self._metrics.observe("decode_ms_per_token", dms)
+            profiler.observe("fleet.decode_ms_per_token", dms)
         if t.future.set_running_or_notify_cancel():
             if exc is None:
                 self._count("responses")
-                res = rfut.result()
+                res = rfut.result() if override is None else override
                 # handle contract: a LIST of output arrays (decode =
                 # one token tensor) — unwrap for generate() callers
-                if t.spec["kind"] == "decode" \
+                if t.spec["kind"] in ("decode", "migrate") \
                         and isinstance(res, (list, tuple)):
                     res = res[0]
                 t.future.set_result(res)
@@ -1317,7 +1696,34 @@ class Router:
                                "transport_dead", None)
                 if dead is not None:
                     self._replica_failed(rid, dead)
+            if self._roles_on:
+                self._refresh_ledger(rids)
             time.sleep(max(0.02, interval))
+
+    def _refresh_ledger(self, rids):
+        """Re-measure each replica's decode-capacity ledger (free pool
+        blocks / page size / cache_util) from its stats — the signals
+        role-aware admission and the autoscaler route on.  Best-effort:
+        a replica that cannot answer keeps its last measurement (a
+        dying one gets convicted by the passes above, not here)."""
+        for rid in rids:
+            state = self._replicas.get(rid)
+            if state is None or state.dead:
+                continue
+            try:
+                st = state.handle.stats()
+            except Exception:  # noqa: BLE001 — measurement only
+                continue
+            with self._lock:
+                if st.get("cache_blocks_free") is not None:
+                    state.free_blocks = int(st["cache_blocks_free"])
+                if st.get("kv_block"):
+                    state.kv_block = int(st["kv_block"])
+                if st.get("cache_util") is not None:
+                    state.cache_util = float(st["cache_util"])
+                role = st.get("role")
+                if role in REPLICA_ROLES:
+                    state.role = role
 
     def _replica_failed(self, rid: int, exc: BaseException):
         """Convict one replica: mark dead, re-queue its unretired
@@ -1338,8 +1744,10 @@ class Router:
                 "in-flight request(s) on the survivors", rid, exc,
                 len(orphans))
             for t in orphans:
-                if t.attempts <= self._retry_budget:
+                t.failures += 1
+                if t.failures <= self._retry_budget:
                     t.queued = True
+                    self._reset_phase_locked(t)
                     self._requeue_retry_locked(t, rid, exc)
                 else:
                     t.delivered = True
@@ -1423,6 +1831,146 @@ class Router:
                     "replicas": reports,
                     "total_ms": (time.monotonic() - t0) * 1e3}
 
+    # -- disaggregated roles --------------------------------------------
+    def set_role(self, rid: int, role: str,
+                 drain_timeout: Optional[float] = None) -> Dict:
+        """Flip one replica's disaggregated role through the same
+        quiesce machinery the rolling weight swap uses: stop routing
+        to it, wait for its in-flight tickets to deliver, flip, warm,
+        re-admit.  Traffic redistributes around it meanwhile; a flip
+        that would leave the fleet without a prefill or a decode side
+        is refused (the last replica of a role never flips away)."""
+        if role not in REPLICA_ROLES:
+            raise MXNetError(
+                f"replica role {role!r} must be one of {REPLICA_ROLES}")
+        drain_timeout = (self._swap_drain_timeout if drain_timeout
+                         is None else float(drain_timeout))
+        with self._cond:
+            state = self._replicas.get(int(rid))
+            if state is None or state.dead:
+                raise MXNetError(f"no live replica {rid} to re-role")
+            if state.role == role:
+                return {"rid": int(rid), "role": role, "flipped": False}
+            if self._roles_on:
+                for side in ("prefill", "decode"):
+                    if state.role == side and role != side and not any(
+                            s is not state and not s.dead
+                            and s.role == side
+                            for s in self._replicas.values()):
+                        raise MXNetError(
+                            f"refusing to flip replica {rid} off "
+                            f"{side!r}: it is the last {side} replica "
+                            "— a one-sided fleet cannot serve")
+            old = state.role
+            state.draining = True
+        t0 = time.monotonic()
+        try:
+            deadline = t0 + drain_timeout
+            while True:
+                with self._lock:
+                    left = len(state.outstanding)
+                if left == 0:
+                    break
+                if time.monotonic() > deadline:
+                    raise MXNetError(
+                        f"role flip aborted: replica {rid} still has "
+                        f"{left} ticket(s) in flight after "
+                        f"{drain_timeout:.0f}s")
+                time.sleep(0.005)
+            drain_ms = (time.monotonic() - t0) * 1e3
+            setter = getattr(state.handle, "set_role", None)
+            if setter is None:
+                raise MXNetError(
+                    f"replica {rid} handle has no set_role() — it "
+                    "cannot take a disaggregated role")
+            setter(role)
+            with self._lock:
+                state.role = role
+                state.role_flips += 1
+            self._count("role_flips")
+            _log.warning("[fleet] replica %d role %s -> %s "
+                         "(drained in %.0f ms)", rid, old, role,
+                         drain_ms)
+            return {"rid": int(rid), "role": role, "from": old,
+                    "flipped": True, "drain_ms": drain_ms,
+                    "total_ms": (time.monotonic() - t0) * 1e3}
+        finally:
+            with self._cond:
+                state.draining = False
+                self._cond.notify_all()
+
+    def autoscale_once(self) -> Optional[Dict]:
+        """One evaluation of the prefill/decode split; returns the flip
+        report or None.  Pressure per role = queued + in-flight work,
+        weighted by the measured per-kind cost EMAs, normalized by the
+        role's replica count — plus decode-pool fullness (a nearly
+        full decode pool is decode pressure even at shallow queues)
+        and the interactive SLO burn (a burning TTFT objective is
+        prefill starvation; a burning per-token objective is decode
+        starvation).  A flip needs a 2x imbalance (hysteresis — the
+        drain it triggers is not free), moves ONE replica per call,
+        and never strips the last replica of a role."""
+        with self._lock:
+            if not self._roles_on or not self._alive:
+                return None
+            pre = [s for s in self._replicas.values()
+                   if not s.dead and s.role == "prefill"]
+            dec = [s for s in self._replicas.values()
+                   if not s.dead and s.role == "decode"]
+            if not pre or not dec:
+                return None
+            # cost-EMA weights: ms of work one queued item represents
+            w_pre = [v for (k, _), v in self._cost.items()
+                     if k == "decode"]
+            w_dec = [v for (k, _), v in self._cost.items()
+                     if k == "migrate"]
+            w_pre = sum(w_pre) / len(w_pre) if w_pre else 1.0
+            w_dec = sum(w_dec) / len(w_dec) if w_dec else 1.0
+            q_pre = sum(len(s.outstanding) for s in pre) \
+                + sum(1 for t in self._pending
+                      if t.spec["kind"] == "decode" and t.phase != 2)
+            q_dec = sum(len(s.outstanding) for s in dec) \
+                + sum(1 for t in self._pending if t.phase == 2)
+            p_pre = q_pre * w_pre / len(pre)
+            p_dec = q_dec * w_dec / len(dec)
+            utils = [s.cache_util for s in dec
+                     if s.cache_util is not None]
+            if utils and max(utils) > 0.85:
+                # decode pools nearly full: migrations are about to
+                # stall on admission regardless of queue depth
+                p_dec *= 2.0
+            burn_ttft = self._slo.burn_rate("interactive", "ttft")
+            burn_tpt = self._slo.burn_rate("interactive", "tpt")
+            if burn_ttft > 1.0 >= burn_tpt:
+                p_pre *= 2.0
+            elif burn_tpt > 1.0 >= burn_ttft:
+                p_dec *= 2.0
+            flip_to = None
+            if p_pre > 2.0 * max(p_dec, 1e-9) and len(dec) > 1:
+                flip_to = "prefill"
+                victim = min(dec, key=lambda s: len(s.outstanding))
+            elif p_dec > 2.0 * max(p_pre, 1e-9) and len(pre) > 1:
+                flip_to = "decode"
+                victim = min(pre, key=lambda s: len(s.outstanding))
+            if flip_to is None:
+                return None
+            vrid = victim.handle.rid
+        report = self.set_role(vrid, flip_to)
+        report["pressure"] = {"prefill": round(p_pre, 3),
+                              "decode": round(p_dec, 3)}
+        return report
+
+    def _autoscale_loop(self):
+        while True:
+            time.sleep(self._autoscale_interval)
+            with self._lock:
+                if not self._alive:
+                    return
+            try:
+                self.autoscale_once()
+            except Exception as exc:  # noqa: BLE001 — keep evaluating
+                _log.warning("[fleet] autoscale pass failed: %s", exc)
+
     # -- stats ----------------------------------------------------------
     def stats(self) -> Dict:
         summ = self._metrics.summary()
@@ -1437,13 +1985,32 @@ class Router:
         out["requests_per_s"] = summ["rates"].get("requests", 0.0)
         out["shed_rate"] = (out["shed"] / out["requests"]
                             if out["requests"] else 0.0)
+        # disaggregation: migration counters + the phase-isolated
+        # latency split (TTFT from the prefill side, per-token from
+        # the decode side — the isolation the role split buys)
+        for k in ("migrations", "migration_bytes", "re_prefills",
+                  "role_flips"):
+            out[k] = int(c.get(k, 0))
+        out["migration_ms_total"] = round(
+            float(c.get("migration_ms_total", 0.0)), 6)
+        for key, hist in (("migration", "migration_ms"),
+                          ("ttft", "ttft_ms"),
+                          ("decode_per_token", "decode_ms_per_token")):
+            h = summ["histograms"].get(hist)
+            out[f"{key}_p50_ms"] = h["p50"] if h else None
+            out[f"{key}_p99_ms"] = h["p99"] if h else None
+        out["migrations_per_s"] = summ["rates"].get("migrations", 0.0)
         with self._lock:
             out["pending"] = len(self._pending)
             out["replicas"] = {
                 rid: {"dead": s.dead, "draining": s.draining,
                       "outstanding": len(s.outstanding),
-                      "swaps": s.swaps}
+                      "swaps": s.swaps, "role": s.role,
+                      "role_flips": s.role_flips,
+                      "free_blocks": s.free_blocks,
+                      "cache_util": s.cache_util}
                 for rid, s in self._replicas.items()}
+            out["disagg"] = self._roles_on and self._disagg_live()
         out["alive"] = self.alive_replicas()
         out["weights_step"] = self._weights_step
         out["cost_model_ms"] = {f"{k}:{b}": round(v, 3)

@@ -309,6 +309,57 @@ class BlockAllocator:
         self._free.append(page)
         self._update_gauges()
 
+    def export_pages(self, pages: List[int]) -> int:
+        """Detach EXCLUSIVELY-held pages whose bytes have been shipped
+        to another pool (live KV migration, see ``fleet.Router``
+        roles).  The slots return to the free list — the data now
+        lives on the importing replica — but the operation is audited
+        separately from :meth:`free`: the ``pages_exported`` counter is
+        what reconciles a disaggregated fleet's page movement.
+
+        A shared or parked page refuses loudly: migration ships a
+        stream's PRIVATE tail, and a page the prefix index (or another
+        stream) still maps must be detached from the index first
+        (``PrefixCache.detach``) or merely released, never exported.
+        Returns the number of pages exported."""
+        for p in pages:
+            if p == SCRATCH_PAGE:
+                raise MXNetError("attempt to export the scratch page")
+            if p in self._parked:
+                raise MXNetError(
+                    f"export of parked page {p} — reclaim/revive it "
+                    f"first; a parked page has no owning stream")
+            if p not in self._owner:
+                raise MXNetError(
+                    f"export of non-live page {p} (owned pages: "
+                    f"{sorted(self._owner)})")
+            if self._refs.get(p, 0) > 1:
+                raise MXNetError(
+                    f"export of page {p} with {self._refs[p]} live "
+                    f"references — another stream still reads it; "
+                    f"detach it from the prefix index or release() "
+                    f"this stream's reference instead")
+        for p in pages:
+            del self._owner[p]
+            self._refs.pop(p, None)
+            self._free.append(p)
+        profiler.inc_counter("serving.kv_pages_exported", len(pages))
+        self._update_gauges()
+        return len(pages)
+
+    def import_pages(self, n: int, owner=None) -> Optional[List[int]]:
+        """Allocate ``n`` fresh pages to receive migrated KV bytes — a
+        block-table splice target on the importing replica.  Same
+        all-or-nothing contract as :meth:`alloc` (None = pool cannot
+        take the stream right now; the caller preempts or refuses the
+        migration), plus the ``pages_imported`` audit counter that
+        mirrors the exporter's ``pages_exported``."""
+        pages = self.alloc(n, owner=owner)
+        if pages is not None:
+            profiler.inc_counter("serving.kv_pages_imported",
+                                 len(pages))
+        return pages
+
     def free(self, pages: List[int]) -> None:
         """Terminal free of EXCLUSIVELY-held pages.  A page another
         stream still references raises loudly — returning it to the

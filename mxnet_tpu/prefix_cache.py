@@ -286,6 +286,28 @@ class PrefixCache:
             if self.allocator.is_parked(n.page):
                 self.allocator.reclaim(n.page)
 
+    def detach(self, pages: List[int]) -> int:
+        """Un-index pages about to be EXPORTED (live KV migration): a
+        migrating stream's pages leave this replica's pool, so any
+        radix entry mapping them — and the whole subtree hanging off
+        it, whose chains would dangle — must stop being matchable
+        first.  Pages other streams still hold merely lose their index
+        entry (their holders keep reading them and they free at their
+        own release); parked descendants of a dropped chain are
+        reclaimed by :meth:`_drop_chain` as usual.  Returns the number
+        of pages whose index entry was dropped.  After detach, a page
+        held only by the migrating stream is exclusively owned and
+        eligible for ``BlockAllocator.export_pages``."""
+        dropped = 0
+        for p in pages:
+            node = self._page_node.get(p)
+            if node is None:
+                continue
+            self._drop_chain(node)
+            dropped += 1
+            profiler.inc_counter("serving.prefix_detached")
+        return dropped
+
     def _evictable(self) -> List[_Node]:
         """Leaf nodes whose page is parked, LRU-first."""
         cands = [n for n in self.index.leaves()

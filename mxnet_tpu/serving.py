@@ -909,7 +909,7 @@ class _Stream:
                  "seed", "generated", "blocks", "length", "next_token",
                  "resume", "t_submit", "t_admit", "trace", "t_enqueue",
                  "cached_len", "await_first", "t_chunk0", "slo_class",
-                 "canary", "cost")
+                 "canary", "cost", "migrate")
 
     def __init__(self, sid, prompt, max_new, temp, eos, future, seed,
                  trace=None, slo_class="interactive", canary=False):
@@ -934,6 +934,7 @@ class _Stream:
         self.t_chunk0 = 0.0           # chunked prefill: first chunk start
         self.slo_class = slo_class    # validated at submit()
         self.canary = canary          # excluded from request counters
+        self.migrate = False          # prefill-only: export after TTFT
         self.cost = _slo.CostRecord(sid, slo_class, canary)
         self.cost.prompt_tokens = int(prompt.size)
 
@@ -1364,6 +1365,10 @@ class DecodeEngine:
         self._cond = threading.Condition(self._lock)
         self._pending: List[_Stream] = []
         self._active: List[_Stream] = []
+        # queued KV-page imports (meta, slabs, future): spliced into
+        # the pool ON the scheduler thread (pools are donated jax
+        # buffers — only the loop may touch them)
+        self._imports: List[tuple] = []
         self._admitting: Optional[_Stream] = None
         self._prefilling: Optional[_Stream] = None  # mid-chunked-prefill
         self._accepting = True
@@ -1410,9 +1415,19 @@ class DecodeEngine:
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=32, temperature=None,
                eos_id=None, seed=None, trace=None,
-               slo_class="interactive", canary=False) -> Future:
+               slo_class="interactive", canary=False,
+               prefill_only=False) -> Future:
         """Enqueue one generation; the Future resolves to the np.int32
         array of generated token ids (eos, when hit, is included).
+
+        ``prefill_only=True`` is the disaggregated-serving prefill
+        phase: the stream runs admission + (chunked/prefix-shared)
+        prefill, samples its FIRST token, and then — instead of
+        joining the decode batch — its KV pages are gathered off the
+        pool and the Future resolves to a migration payload dict
+        (``meta`` + ``kv_arrays``) for :meth:`import_stream` on a
+        decode-role replica.  Sampling stays keyed by (engine seed,
+        stream seed, position), so the handoff is bit-invisible.
 
         ``slo_class`` ("interactive"/"batch", loudly validated) keys
         the request's SLO objectives and its cost-record aggregation;
@@ -1456,6 +1471,10 @@ class DecodeEngine:
             raise MXNetError(
                 f"request needs {need} cache blocks but the pool only "
                 f"has {self._alloc.capacity}")
+        if prefill_only and self._mesh is not None:
+            raise MXNetError(
+                "prefill_only export from a tp/pp-meshed engine is "
+                "not supported yet (page slabs are per-shard)")
         temp = self._temperature if temperature is None \
             else float(temperature)
         eos = self._eos if eos_id is None else eos_id
@@ -1468,6 +1487,7 @@ class DecodeEngine:
                         seed=(self._next_sid + 1 if seed is None
                               else int(seed)), trace=trace,
                         slo_class=slo_class, canary=canary)
+            s.migrate = bool(prefill_only)
             self._next_sid += 1
             self._pending.append(s)
             self._owned.add(fut)
@@ -1676,6 +1696,17 @@ class DecodeEngine:
         out["cost_by_class"] = self._cost_agg.by_class()
         out["cost_flops_per_s"] = round(
             summ["rates"].get("cost_flops", 0.0), 3)
+        # disaggregated serving: KV-page migration traffic.  The _out
+        # counters and their cost-record mirrors increment at the same
+        # site, so sum(records) == these — same conservation contract
+        # as tokens/cow_copies.
+        out["migrations_out"] = int(c.get("migrations_out", 0))
+        out["migrations_in"] = int(c.get("migrations_in", 0))
+        out["migration_bytes"] = int(c.get("migration_bytes", 0))
+        out["migration_ms"] = round(c.get("migration_ms", 0.0), 6)
+        out["migrations_per_s"] = round(
+            summ["rates"].get("migrations_out", 0.0)
+            + summ["rates"].get("migrations_in", 0.0), 4)
         return out
 
     def cost_records(self) -> List[dict]:
@@ -1738,6 +1769,11 @@ class DecodeEngine:
                     streams.append(self._prefilling)
                 self._prefilling = None
             self._pending, self._active = [], []
+            imports, self._imports = self._imports, []
+        for item in imports:  # queued page imports never spliced
+            fut = item[2]
+            if fut.set_running_or_notify_cancel():
+                fut.set_exception(exc)
         for s in streams:
             if s.blocks:
                 self._release_pages(s.blocks)
@@ -2067,10 +2103,16 @@ class DecodeEngine:
                 with self._cond:
                     while self._alive and not self._pending \
                             and not self._active \
+                            and not self._imports \
                             and self._prefilling is None:
                         self._cond.wait(timeout=0.5)
                     if not self._alive:
                         return
+                if self._imports:
+                    # splice migrated-in KV pages FIRST: an imported
+                    # stream is past its prefill, so it joins the very
+                    # next decode batch (migration adds no queue wait)
+                    self._absorb_imports()
                 self._admit()
                 if self._prefilling is not None:
                     # ONE chunk per iteration: the decode step below
@@ -2191,6 +2233,14 @@ class DecodeEngine:
             s.blocks = pages + new_pages
             if cached == len(seq) and cached > 0:
                 self._full_hit(s, seq)
+                if s.migrate:
+                    # ship the cached pages as-is: the importer enters
+                    # in full-hit state (replaying the last prompt
+                    # token), so its first decode step samples the
+                    # first token with the same (seed, position) key
+                    with self._lock:
+                        self._active.remove(s)
+                    self._export_stream(s)
             else:
                 self._prefill(s, seq, s.blocks)
             self._admitting = None
@@ -2326,9 +2376,11 @@ class DecodeEngine:
         chunk) chunked prefill: register the prompt's pages, book the
         timing/TTFT metrics, deliver the first token, activate or
         retire."""
-        if self._prefix is not None:
+        if self._prefix is not None and not s.migrate:
             # the prompt's full pages become shareable; blocks already
-            # indexed keep the incumbent page (ours stays private)
+            # indexed keep the incumbent page (ours stays private) — a
+            # migrating stream's pages are about to LEAVE this pool,
+            # so they never enter the index
             self._prefix.register(s.prompt, s.blocks)
         prefill_ms = (t_done - t_pre0) * 1e3
         self._metrics.observe("prefill_ms", prefill_ms)
@@ -2372,7 +2424,9 @@ class DecodeEngine:
         self._count("prefills")
         self._count("prefill_tokens", ns)  # uncached tokens only
         s.cost.prefill_tokens += ns
-        if s.done():  # max_new == 1 or instant eos
+        if s.migrate:
+            self._export_stream(s)
+        elif s.done():  # max_new == 1 or instant eos
             self._retire(s)
         else:
             with self._lock:
@@ -2569,6 +2623,235 @@ class DecodeEngine:
             # canary delivery outcomes are the PROBER's to book (it
             # also sees the failures this path never reaches)
             self._slo.observe_avail(s.slo_class, True)
+
+    # ------------------------------------------------------------------
+    # live KV page migration (disaggregated prefill/decode roles)
+    # ------------------------------------------------------------------
+    def _export_stream(self, s: _Stream):
+        """Gather a prefill-only stream's KV pages off the pool and
+        resolve its Future with a migration payload: ``meta`` (stream
+        state — seed, lengths, pending token, generated so far) plus
+        ``kv_arrays`` (prompt, generated, then one page slab per pool,
+        scale slabs included for quantized dtypes).  Pages this stream
+        holds exclusively leave the allocator through
+        ``export_pages``; pages still shared with other streams only
+        drop this stream's reference (their bytes were copied out).
+        Runs ON the scheduler thread — the pools are donated jax
+        buffers only the loop may touch."""
+        t0 = time.perf_counter()
+        done = s.done()  # max_new == 1 or instant eos: state-only frame
+        if s.blocks and not done:
+            idx = np.asarray(s.blocks, np.int32)
+            slabs = [np.asarray(p[idx]) for p in self._pools]
+            self._count("d2h_syncs")
+            s.cost.d2h_syncs += 1
+        else:
+            slabs = [np.asarray(p[0:0]) for p in self._pools]
+        nbytes = sum(a.nbytes for a in slabs)
+        meta = {
+            "fmt": 1,
+            "sid": s.sid,
+            "seed": int(s.seed),
+            "temp": float(s.temp),
+            "eos": None if s.eos is None else int(s.eos),
+            "max_new": int(s.max_new),
+            "length": int(s.length),
+            "next_token": int(s.next_token),
+            "await_first": bool(s.await_first),
+            "slo_class": s.slo_class,
+            "canary": bool(s.canary),
+            "done": done,
+            "n_pages": 0 if done else len(s.blocks),
+            "kv_dtype": self._kv_dtype,
+            "kv_block": self._kv_block,
+            "num_layers": self._L,
+            "pool_stride": self._pool_stride,
+            "migration_bytes": int(nbytes),
+        }
+        arrays = [np.asarray(s.prompt, np.int32),
+                  np.asarray(s.generated, np.int32)] + slabs
+        # detach exported pages from the radix index FIRST (a chain
+        # whose pages leave this pool must stop being matchable), then
+        # export exclusive pages / release shared ones
+        s.cost.book_pages(len(s.blocks))
+        if self._prefix is not None:
+            self._prefix.detach(s.blocks)
+        for p in s.blocks:
+            if self._alloc.refcount(p) > 1:
+                self._release_pages([p])
+            else:
+                self._alloc.export_pages([p])
+        s.blocks = []
+        t_done = time.perf_counter()
+        ms = (t_done - t0) * 1e3
+        # the migration counter and the cost-record mirror increment
+        # at THIS site together — the sum(records) == stats()
+        # conservation contract extends to migration_bytes/_ms
+        self._count("migrations_out")
+        self._count("migration_bytes", nbytes)
+        self._count("migration_ms", ms)
+        s.cost.migration_bytes += nbytes
+        s.cost.migration_ms += ms
+        # the router folds the engine-side export cost into its
+        # end-to-end migration_ms histogram — ship it in the meta
+        meta["export_ms"] = round(ms, 6)
+        self._metrics.observe("migration_export_ms", ms)
+        profiler.observe("serving.migration_export_ms", ms)
+        if s.trace is not None:
+            profiler.add_trace_event(
+                "serving.migrate_out", t0, t_done - t0,
+                s.trace.child(), cat="serving",
+                args={"sid": s.sid, "pages": int(meta["n_pages"]),
+                      "bytes": int(nbytes)})
+        self._cost_agg.add(s.cost)
+        if s.cost.flops_est:
+            self._count("cost_flops", s.cost.flops_est)
+        if s.future.set_running_or_notify_cancel():
+            s.future.set_result({"meta": meta, "kv_arrays": arrays})
+
+    def import_stream(self, meta: dict, arrays, trace=None) -> Future:
+        """Splice a migrated stream into this engine: allocate pages
+        (``BlockAllocator.import_pages``), scatter the shipped slabs
+        into the pools, and continue decode from the exporter's exact
+        state.  Sampling is keyed by (engine seed, stream seed,
+        position) and the importer reuses the exporter's stream seed,
+        so the tokens are BIT-IDENTICAL to a never-migrated run.
+        Thread-safe; the splice itself runs on the scheduler thread.
+        The Future resolves to the FULL generated token array
+        (including tokens the exporter's prefill already emitted)."""
+        if self._mesh is not None:
+            raise MXNetError(
+                "KV page migration onto a tp/pp-meshed engine is not "
+                "supported yet (page slabs are per-shard)")
+        if int(meta.get("fmt", -1)) != 1:
+            raise MXNetError(
+                f"migration payload fmt {meta.get('fmt')!r} unknown")
+        if meta["kv_dtype"] != self._kv_dtype:
+            raise MXNetError(
+                f"migration kv_dtype {meta['kv_dtype']!r} != this "
+                f"engine's {self._kv_dtype!r} — roles must serve "
+                f"identical pool dtypes")
+        if int(meta["kv_block"]) != self._kv_block:
+            raise MXNetError(
+                f"migration page size {meta['kv_block']} != this "
+                f"engine's kv_block {self._kv_block} — pages only "
+                f"splice across an identical page grid")
+        if int(meta["num_layers"]) != self._L \
+                or int(meta["pool_stride"]) != self._pool_stride:
+            raise MXNetError(
+                "migration layer/pool layout mismatch: "
+                f"{meta['num_layers']}x{meta['pool_stride']} vs "
+                f"{self._L}x{self._pool_stride}")
+        if len(arrays) != 2 + len(self._pools):
+            raise MXNetError(
+                f"migration payload has {len(arrays)} arrays; "
+                f"expected prompt + generated + {len(self._pools)} "
+                f"page slabs")
+        n_pages = int(meta["n_pages"])
+        for p, slab in zip(self._pools, arrays[2:]):
+            want = (n_pages,) + tuple(np.shape(p))[1:]
+            if tuple(np.shape(slab)) != want \
+                    or np.dtype(slab.dtype) != np.dtype(p.dtype):
+                raise MXNetError(
+                    f"migration slab {np.shape(slab)}/{slab.dtype} "
+                    f"does not match pool row {want}/{p.dtype}")
+        if n_pages > self._alloc.capacity:
+            raise MXNetError(
+                f"migrated stream holds {n_pages} pages but this "
+                f"pool only has {self._alloc.capacity}")
+        fut: Future = Future()
+        with self._cond:
+            if not self._accepting:
+                raise EngineClosedError(
+                    self._reject or "DecodeEngine is closed")
+            self._imports.append((dict(meta), list(arrays), fut,
+                                  trace, time.perf_counter()))
+            self._owned.add(fut)
+            self._cond.notify_all()
+        fut.add_done_callback(self._disown)
+        return fut
+
+    def _import_alloc(self, n: int, owner) -> Optional[List[int]]:
+        """Pages for an incoming migration: evict parked prefix pages
+        first, then preempt the youngest resumable stream — the same
+        pressure ladder admission uses."""
+        while True:
+            if self._prefix is not None:
+                short = n - self._alloc.free_list_blocks
+                if short > 0:
+                    self._prefix.evict(short)
+            pages = self._alloc.import_pages(n, owner=owner)
+            if pages is not None:
+                return pages
+            victims = [v for v in self._active
+                       if self._chunk
+                       or v.length <= self._prefill_buckets[-1]]
+            if not victims:
+                return None
+            productive = [v for v in victims
+                          if self._reclaimable(v) > 0]
+            victim = max(productive or victims,
+                         key=lambda v: v.t_admit)
+            self._preempt(victim)
+
+    def _absorb_imports(self):
+        """Drain the queued migrations (scheduler thread): allocate,
+        scatter each payload's slabs into the pools, and activate the
+        stream exactly where the exporter cut it."""
+        with self._lock:
+            items, self._imports = self._imports, []
+        for meta, arrays, fut, trace, t_recv in items:
+            t0 = time.perf_counter()
+            n_pages = int(meta["n_pages"])
+            sid = self._next_sid
+            self._next_sid += 1
+            pages = self._import_alloc(n_pages, owner=sid)
+            if pages is None:
+                if fut.set_running_or_notify_cancel():
+                    fut.set_exception(MXNetError(
+                        f"cannot import migrated stream: {n_pages} "
+                        f"pages unavailable (pool: "
+                        f"{self._alloc.capacity} blocks) and no "
+                        f"preemptable stream remains"))
+                continue
+            if n_pages:
+                idx = np.asarray(pages, np.int32)
+                pools = list(self._pools)
+                for i, slab in enumerate(arrays[2:]):
+                    pools[i] = pools[i].at[idx].set(slab)
+                self._pools = tuple(pools)
+            prompt = np.asarray(arrays[0], np.int32)
+            s = _Stream(sid, prompt, int(meta["max_new"]),
+                        float(meta["temp"]),
+                        None if meta["eos"] is None
+                        else int(meta["eos"]),
+                        fut, seed=int(meta["seed"]), trace=trace,
+                        slo_class=meta.get("slo_class",
+                                           "interactive"),
+                        canary=bool(meta.get("canary", False)))
+            s.generated = [int(t) for t in np.asarray(arrays[1])]
+            s.blocks = pages
+            s.length = int(meta["length"])
+            s.next_token = int(meta["next_token"])
+            s.await_first = bool(meta.get("await_first", False))
+            s.cost.book_pages(0)  # page-second clock starts at splice
+            t_done = time.perf_counter()
+            ms = (t_done - t0) * 1e3
+            self._count("migrations_in")
+            self._metrics.observe("migration_import_ms", ms)
+            profiler.observe("serving.migration_import_ms", ms)
+            if trace is not None:
+                profiler.add_trace_event(
+                    "serving.migrate_in", t0, t_done - t0,
+                    trace.child(), cat="serving",
+                    args={"sid": sid, "pages": n_pages,
+                          "bytes": int(meta.get("migration_bytes",
+                                                0))})
+            if s.done():  # exporter shipped a finished stream
+                self._retire(s)
+            else:
+                with self._lock:
+                    self._active.append(s)
 
     def _propose(self, s: _Stream) -> np.ndarray:
         """Draft tokens for one stream, capped by the step's usable
@@ -2936,6 +3219,10 @@ class ReplicaHarness:
       never leaves a replica refusing traffic.
     """
 
+    #: replica roles a disaggregated fleet may assign (``mixed`` is
+    #: the classic do-everything replica and the default)
+    ROLES = ("prefill", "decode", "mixed")
+
     def __init__(self, engine):
         if not isinstance(engine, (InferenceEngine, DecodeEngine)):
             raise MXNetError(
@@ -2945,6 +3232,7 @@ class ReplicaHarness:
         self.kind = "decode" if isinstance(engine, DecodeEngine) \
             else "infer"
         self.weights_step = -1  # last swap's checkpoint step
+        self.role = None  # disagg role; None = roles never enabled
         # /statusz: the harness view supersedes the bare engine's —
         # same stats plus kind/inflight/weights_step (what fleet_top
         # renders per replica)
@@ -2966,6 +3254,55 @@ class ReplicaHarness:
                                   temperature=temperature, eos_id=eos_id,
                                   seed=seed, trace=trace)
 
+    # -- disaggregated prefill/decode -----------------------------------
+    def set_role(self, role: str):
+        """Assign this replica's disaggregated-serving role.  The
+        router flips roles only through its drain machinery (quiesce →
+        flip → warm), so by the time this runs the engine is idle; the
+        flip itself is just bookkeeping plus a warmup so the first
+        request in the new role never pays a compile."""
+        if role not in self.ROLES:
+            raise MXNetError(
+                f"replica role {role!r} must be one of {self.ROLES}")
+        if self.kind != "decode":
+            raise MXNetError(
+                "replica roles apply to decode replicas only; an "
+                "InferenceEngine replica has no prefill/decode split")
+        self.role = role
+        profiler.inc_counter("serving.role_flips")
+        self.engine.warmup()
+
+    def submit_prefill_export(self, prompt, max_new_tokens=32,
+                              temperature=None, eos_id=None, seed=None,
+                              trace=None) -> Future:
+        """Disagg phase 1: admission + prefill + first token, then the
+        KV pages leave the pool as a migration payload (the Future's
+        result — see :meth:`DecodeEngine.submit` ``prefill_only``)."""
+        if self.kind != "decode":
+            raise MXNetError("replica serves inference requests; "
+                             "a prefill-export request cannot ride it")
+        if self.role == "decode":
+            raise MXNetError(
+                "replica role is 'decode' — prefill-export requests "
+                "must route to a prefill-role replica")
+        return self.engine.submit(prompt, max_new_tokens,
+                                  temperature=temperature, eos_id=eos_id,
+                                  seed=seed, trace=trace,
+                                  prefill_only=True)
+
+    def submit_import(self, meta: dict, arrays, trace=None) -> Future:
+        """Disagg phase 2: splice a migrated stream's KV pages into
+        this replica's pool and continue its decode (see
+        :meth:`DecodeEngine.import_stream`)."""
+        if self.kind != "decode":
+            raise MXNetError("replica serves inference requests; "
+                             "a KV-page import cannot ride it")
+        if self.role == "prefill":
+            raise MXNetError(
+                "replica role is 'prefill' — migrated streams must "
+                "land on a decode-role replica")
+        return self.engine.import_stream(meta, arrays, trace=trace)
+
     # -- router-facing state --------------------------------------------
     def inflight(self) -> int:
         return self.engine.inflight()
@@ -2981,6 +3318,8 @@ class ReplicaHarness:
         out["kind"] = self.kind
         out["inflight"] = self.inflight()
         out["weights_step"] = self.weights_step
+        if self.role is not None:  # roles never enabled → not exported
+            out["role"] = self.role
         return out
 
     # -- rolling weight swap --------------------------------------------

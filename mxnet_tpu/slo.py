@@ -580,10 +580,12 @@ def canary_prompt(vocab_size: int, n: int = 4) -> np.ndarray:
 #: Additive cost fields — every key sums across records and (for the
 #: starred ones) reconciles EXACTLY with the engine counters because
 #: both sides increment at the same program points:
-#: tokens*, prefill_tokens*, cow_copies*.
+#: tokens*, prefill_tokens*, cow_copies*, migration_bytes*,
+#: migration_ms*.
 COST_FIELDS = ("prompt_tokens", "prefill_tokens", "tokens",
                "decode_steps", "spec_accepted", "cow_copies",
-               "d2h_syncs", "page_s", "flops_est")
+               "d2h_syncs", "page_s", "flops_est",
+               "migration_bytes", "migration_ms")
 
 
 class CostRecord:
@@ -604,7 +606,8 @@ class CostRecord:
         self.t_retired = 0.0
         self.pg_t = self.t_submit  # last page-count booking time
         for f in COST_FIELDS:
-            setattr(self, f, 0.0 if f in ("page_s", "flops_est")
+            setattr(self, f, 0.0 if f in ("page_s", "flops_est",
+                                          "migration_ms")
                     else 0)
 
     def book_pages(self, n_pages: int, now: Optional[float] = None):
@@ -618,6 +621,7 @@ class CostRecord:
     def as_dict(self) -> dict:
         d = {f: getattr(self, f) for f in COST_FIELDS}
         d["page_s"] = round(d["page_s"], 6)
+        d["migration_ms"] = round(d["migration_ms"], 6)
         d.update(sid=self.sid, slo_class=self.slo_class,
                  canary=self.canary,
                  wall_s=round(self.t_retired - self.t_submit, 6))

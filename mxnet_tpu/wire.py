@@ -35,6 +35,7 @@ __all__ = [
     "unpack_tensor", "send_frame", "recv_frame", "recv_exact",
     "err_body", "raise_if_err", "sign", "verify", "pack_signed_json",
     "unpack_signed_json", "is_transient", "pack_trace", "unpack_trace",
+    "pack_page_frame", "unpack_page_frame",
 ]
 
 U32 = struct.Struct("!I")
@@ -255,3 +256,55 @@ def unpack_signed_json(secret: bytes, buf: memoryview, off: int,
     mac = bytes(buf[off:off + 32])
     verify(secret, blob, mac, what)
     return json.loads(blob.decode()), off + 32
+
+
+# ---------------------------------------------------------------------------
+# KV page-migration frames (disaggregated prefill/decode serving)
+# ---------------------------------------------------------------------------
+
+
+def pack_page_frame(secret: bytes, meta: dict, arrays) -> bytes:
+    """One signed KV-page migration frame: ``u32 len | json meta |
+    u32 count | tensors... | 32-byte mac``.
+
+    The MAC covers the ENTIRE body — meta AND page slabs — unlike the
+    control frames (which only carry structured metadata): migrated
+    pages are spliced straight into the receiver's pool and decoded
+    against without re-validation, so a forged or bit-flipped slab
+    must be refused before any byte lands in the block table.  Meta is
+    JSON (stream identity, seed, lengths, dtype); slabs ride the
+    no-pickle tensor encoding at wire dtype — a quantized pool ships
+    its int8/fp8 value slabs plus their fp32 scale slabs as-is, so
+    migration bytes track the storage dtype, not fp32."""
+    import json
+
+    blob = json.dumps(meta).encode()
+    parts = [U32.pack(len(blob)), blob, U32.pack(len(arrays))]
+    for a in arrays:
+        parts.append(pack_tensor(a))
+    body = b"".join(parts)
+    return body + sign(secret, body)
+
+
+def unpack_page_frame(secret: bytes, buf: memoryview,
+                      what: str = "migration frame"):
+    """→ (meta, [np arrays]).  Verifies the whole-body MAC BEFORE
+    parsing anything (see :func:`pack_page_frame`)."""
+    import json
+
+    if len(buf) < 40:  # u32 + empty json + u32 + mac is already more
+        raise MXNetError(f"{what}: truncated ({len(buf)} bytes)")
+    body, mac = buf[:-32], bytes(buf[-32:])
+    verify(secret, bytes(body), mac, what)
+    off = 0
+    (blen,) = U32.unpack_from(body, off)
+    off += 4
+    meta = json.loads(bytes(body[off:off + blen]).decode())
+    off += blen
+    (count,) = U32.unpack_from(body, off)
+    off += 4
+    arrays = []
+    for _ in range(count):
+        arr, off = unpack_tensor(body, off)
+        arrays.append(arr)
+    return meta, arrays

@@ -14,6 +14,25 @@ Drill (--drill):
    "replica_deaths": 1, "p99_trace_ms": [...], "swap_ok": true,
    "swap_shed": 0, ...}
 
+Disaggregated serving (--disagg): the same mixed chat + long-prompt-
+hammer workload against a prefill/decode role-split fleet AND the
+classic mixed fleet; every disagg answer is bit-checked against a
+local never-migrated reference engine (same params, same seeds):
+  {"metric": "fleet_disagg", "disagg": {"ttft_p99_ms": N,
+   "decode_p99_ms_per_token": N, "migration_ms": {"p50": N, "p99": N},
+   "migration_bytes": {"total": N, "frames": N, "avg_per_frame": N},
+   ...}, "mixed": {...}, "ttft_isolation_vs_mixed": N}
+plus one companion {"metric": "fleet_disagg_<headline>", "value": N}
+line per headline (ttft_p99 / decode_p99_per_token / migration_p50)
+for perf_sentinel --record.
+
+Per-role kill drill (--disagg-drill prefill|decode): kill -9 the
+replica of that role mid-stream under disaggregated load; zero lost,
+zero mismatched, and the stitched trace must show the router.migrate
+cross-process edge:
+  {"metric": "fleet_disagg_drill_<role>", "lost": 0, "mismatched": 0,
+   "re_prefills": N, "migration_edge_in_trace": true, ...}
+
 Methodology (PERF.md appendix "Multi-replica serving"):
 - Replicas are REAL subprocesses, each wrapping a prewarmed
   InferenceEngine over a deterministic tiny MLP (seeded weights, so
@@ -95,6 +114,326 @@ def _reference():
     import mxnet_tpu as mx
 
     return mx.Predictor(_mlp_symbol(), _mlp_params(), {"data": (4, _DIM)})
+
+
+# -- disaggregated prefill/decode fleet (--disagg / --disagg-drill) -------
+
+_V, _KVB, _NL, _NH, _DMODEL, _MAXLEN = 61, 4, 2, 2, 32, 64
+
+
+def _lm_params():
+    """Deterministic tiny-transformer params: every replica process
+    (and the local never-migrated reference) initializes IDENTICAL
+    weights, so a migrated stream's tokens are checkable bit-for-bit
+    against a single-engine run of the same seeds."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+
+    np.random.seed(0)  # initializers draw from the global numpy RNG
+    sym = models.transformer_lm(_V, _MAXLEN, num_layers=_NL,
+                                num_heads=_NH, d_model=_DMODEL,
+                                block_size=_KVB)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, _MAXLEN))],
+             label_shapes=[("softmax_label", (2, _MAXLEN))],
+             for_training=False)
+    mod.init_params(mx.initializer.Xavier(factor_type="in",
+                                          magnitude=2.0))
+    arg, aux = mod.get_params()
+    return {**arg, **aux}
+
+
+def build_decode_replica():
+    """Decode-replica builder (runs INSIDE each replica process)."""
+    import mxnet_tpu as mx
+
+    return mx.DecodeEngine(_lm_params(), vocab_size=_V,
+                           num_layers=_NL, num_heads=_NH,
+                           d_model=_DMODEL, max_len=_MAXLEN,
+                           kv_block=_KVB, max_streams=8,
+                           decode_buckets=[1, 2, 4, 8],
+                           temperature=0.0)
+
+
+def _disagg_jobs(n_chat, n_hammer):
+    """Mixed chat + long-prompt-hammer workload: (i, prompt, max_new)
+    jobs, deterministic in i.  The hammer's near-max-length prompts
+    are what poison TTFT on a mixed fleet — each one monopolizes a
+    prefill slot while short chat turns queue behind it."""
+    jobs = []
+    for i in range(n_chat):
+        rng = np.random.RandomState(2000 + i)
+        jobs.append(("chat", i, rng.randint(
+            1, _V - 1, size=int(rng.randint(4, 9))).astype(np.int32), 12))
+    for i in range(n_hammer):
+        rng = np.random.RandomState(7000 + i)
+        jobs.append(("hammer", n_chat + i, rng.randint(
+            1, _V - 1, size=int(rng.randint(40, 49))).astype(np.int32), 6))
+    return jobs
+
+
+def _gen_closed_loop(router, jobs, clients, expect=None,
+                     lat_split=None):
+    """Closed-loop router.generate over the job list; returns
+    (errs, wall_s).  ``expect[i]`` (when given) is the reference token
+    array — any delivered mismatch is a bit-identity violation."""
+    errs = {"lost": 0, "mismatched": 0, "shed": 0}
+    lock = threading.Lock()
+    qi = {"n": 0}
+
+    def client():
+        from mxnet_tpu.fleet import ShedError
+
+        while True:
+            with lock:
+                if qi["n"] >= len(jobs):
+                    return
+                kind, i, prompt, max_new = jobs[qi["n"]]
+                qi["n"] += 1
+            t0 = time.perf_counter()
+            try:
+                out = router.generate(prompt, max_new_tokens=max_new,
+                                      temperature=0.8,
+                                      seed=5000 + i).result(120)
+            except ShedError:
+                with lock:
+                    errs["shed"] += 1
+                continue
+            except BaseException as exc:  # noqa: BLE001
+                log(f"stream {i} LOST: {exc}")
+                with lock:
+                    errs["lost"] += 1
+                continue
+            ms = (time.perf_counter() - t0) * 1e3
+            with lock:
+                if lat_split is not None:
+                    lat_split.setdefault(kind, []).append(ms)
+                if expect is not None \
+                        and not np.array_equal(np.asarray(out),
+                                               expect[i]):
+                    log(f"stream {i} MISMATCH: {out} != {expect[i]}")
+                    errs["mismatched"] += 1
+
+    threads = [threading.Thread(target=client) for _ in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errs, time.perf_counter() - t0
+
+
+def _expected_tokens(jobs):
+    """Never-migrated reference: one local engine, same params, same
+    (engine seed, stream seed, position) sampling keys."""
+    log("computing local never-migrated reference tokens")
+    ref = build_decode_replica()
+    try:
+        futs = {i: ref.submit(prompt, max_new, temperature=0.8,
+                              seed=5000 + i)
+                for _, i, prompt, max_new in jobs}
+        return {i: np.asarray(f.result(120)) for i, f in futs.items()}
+    finally:
+        ref.close()
+
+
+def _engine_ttft_p99(router):
+    """Max engine-side TTFT p99 across live replicas (the mixed
+    baseline has no router-side TTFT observation point)."""
+    worst = None
+    for state in router._replicas.values():
+        if state.dead:
+            continue
+        try:
+            st = state.handle.stats()
+        except Exception:  # noqa: BLE001
+            continue
+        p99 = ((st.get("latency_breakdown") or {}).get("ttft")
+               or {}).get("p99_ms")
+        if p99 is not None and (worst is None or p99 > worst):
+            worst = p99
+    return worst
+
+
+def main_disagg(args):
+    """TTFT-isolation benchmark: the same mixed chat + long-prompt-
+    hammer workload against (a) a disaggregated prefill/decode fleet
+    and (b) the classic mixed fleet, with every disagg answer
+    bit-checked against a local never-migrated reference."""
+    jobs = _disagg_jobs(
+        int(os.environ.get("FLEET_CHAT", str(args.requests))),
+        int(os.environ.get("FLEET_HAMMER",
+                           str(max(4, args.requests // 4)))))
+    clients = int(os.environ.get("FLEET_CLIENTS", "6"))
+    expect = _expected_tokens(jobs)
+    builder = os.path.abspath(__file__) + ":build_decode_replica"
+    out = {"metric": "fleet_disagg", "replicas": args.replicas,
+           "clients": clients,
+           "jobs": {"chat": sum(1 for j in jobs if j[0] == "chat"),
+                    "hammer": sum(1 for j in jobs if j[0] == "hammer")}}
+    for mode in ("disagg", "mixed"):
+        roles = (["prefill"] + ["decode"] * (args.replicas - 1)
+                 if mode == "disagg" else None)
+        fleet_dir = tempfile.mkdtemp(prefix=f"fleet-{mode}-")
+        from mxnet_tpu import fleet
+
+        router, procs = fleet.launch_local_fleet(
+            args.replicas, fleet_dir, builder, roles=roles,
+            replica_depth=8)
+        try:
+            # warm every replica's executables + the route
+            warm = [("warm", 10_000 + k,
+                     np.asarray([1 + k, 2, 3], np.int32), 2)
+                    for k in range(args.replicas * 2)]
+            _gen_closed_loop(router, warm, 2)
+            router.reset_stats()
+            lat_split = {}
+            errs, wall = _gen_closed_loop(router, jobs, clients,
+                                          expect=expect,
+                                          lat_split=lat_split)
+            s = router.stats()
+            point = {
+                "lost": errs["lost"], "mismatched": errs["mismatched"],
+                "shed": errs["shed"],
+                "streams_per_s": round(len(jobs) / wall, 2),
+                "chat": _pcts(lat_split.get("chat", [])),
+                "hammer": _pcts(lat_split.get("hammer", [])),
+                "engine_ttft_p99_ms": _engine_ttft_p99(router),
+            }
+            if mode == "disagg":
+                point.update({
+                    "ttft_p99_ms": s["ttft_p99_ms"],
+                    "ttft_p50_ms": s["ttft_p50_ms"],
+                    "decode_p99_ms_per_token":
+                        s["decode_per_token_p99_ms"],
+                    "migrations": s["migrations"],
+                    "re_prefills": s["re_prefills"],
+                    "migration_ms": {"p50": s["migration_p50_ms"],
+                                     "p99": s["migration_p99_ms"]},
+                    "migration_bytes": {
+                        "total": s["migration_bytes"],
+                        "frames": s["migrations"],
+                        "avg_per_frame": (
+                            round(s["migration_bytes"]
+                                  / s["migrations"], 1)
+                            if s["migrations"] else None)},
+                })
+            out[mode] = point
+            log(f"{mode}: {point}")
+        finally:
+            router.close(stop_replicas=True)
+            for p in procs:
+                p.terminate()
+    d, m = out["disagg"], out["mixed"]
+    out["value"] = d["ttft_p99_ms"]
+    out["unit"] = "ms"
+    out["ttft_isolation_vs_mixed"] = (
+        round(m["engine_ttft_p99_ms"] / d["engine_ttft_p99_ms"], 2)
+        if d.get("engine_ttft_p99_ms") and m.get("engine_ttft_p99_ms")
+        else None)
+    print(json.dumps(out))
+    # companion one-metric lines so perf_sentinel --record can
+    # baseline each disagg headline independently
+    for metric, value in (
+            ("fleet_disagg_ttft_p99", d["ttft_p99_ms"]),
+            ("fleet_disagg_decode_p99_per_token",
+             d["decode_p99_ms_per_token"]),
+            ("fleet_disagg_migration_p50", d["migration_ms"]["p50"])):
+        if value is not None:
+            print(json.dumps({"metric": metric, "value": round(value, 3),
+                              "unit": "ms", "backend": "cpu",
+                              "model": "transformer_lm"}))
+    ok = (d["lost"] == 0 and d["mismatched"] == 0 and d["shed"] == 0
+          and d["migrations"] > 0)
+    return 0 if ok else 1
+
+
+def main_disagg_drill(args, role):
+    """kill -9 the replica of ONE role mid-stream under disagg load:
+    zero lost, zero mismatched (answers bit-checked against the local
+    never-migrated reference), and the stitched trace shows the
+    router.migrate cross-process edge."""
+    from mxnet_tpu import fleet, profiler
+
+    fleet_dir = args.fleet_dir or tempfile.mkdtemp(
+        prefix=f"fleet-disagg-{role}-")
+    os.environ.setdefault("MXNET_FLIGHT_RECORDER_DIR", fleet_dir)
+    ring_dir = os.environ["MXNET_FLIGHT_RECORDER_DIR"]
+    profiler.init_flight_recorder(ring_dir)
+    n = max(3, args.replicas)
+    roles = ["prefill"] + ["decode"] * (n - 1)
+    jobs = _disagg_jobs(max(12, args.requests), 4)
+    expect = _expected_tokens(jobs)
+    builder = os.path.abspath(__file__) + ":build_decode_replica"
+    router, procs = fleet.launch_local_fleet(
+        n, fleet_dir, builder, roles=roles, replica_depth=8)
+    # rid order == roles order: rid 0 is THE prefill replica
+    victim = 0 if role == "prefill" else 1
+    try:
+        warm = [("warm", 10_000 + k, np.asarray([1 + k, 2], np.int32), 2)
+                for k in range(n * 2)]
+        _gen_closed_loop(router, warm, 2)
+        router.reset_stats()
+        # every delivered answer calls lat_split.setdefault once —
+        # count them so the killer fires genuinely MID-STREAM
+        done = {"n": 0}
+
+        class _Counting(dict):
+            def setdefault(self, k, v):
+                done["n"] += 1
+                return super().setdefault(k, v)
+
+        lat_counting = _Counting()
+
+        def killer():
+            while done["n"] < max(2, len(jobs) // 4):
+                time.sleep(0.005)
+            log(f"kill -9 {role}-role replica rid {victim} "
+                f"(pid {procs[victim].pid})")
+            os.kill(procs[victim].pid, signal.SIGKILL)
+
+        kt = threading.Thread(target=killer)
+        kt.start()
+        errs, wall = _gen_closed_loop(router, jobs, 6, expect=expect,
+                                      lat_split=lat_counting)
+        kt.join()
+        deadline = time.monotonic() + 15.0
+        while router.stats()["replica_deaths"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        s = router.stats()
+        stitched = _stitch_drill_trace(fleet_dir, ring_dir,
+                                       procs[victim].pid)
+        # the migration edge must be visible in the merged trace
+        mig_edge = False
+        if stitched.get("stitched_trace"):
+            with open(stitched["stitched_trace"]) as f:
+                merged = json.load(f)
+            mig_edge = any(e.get("name") == "router.migrate"
+                           for e in merged["traceEvents"])
+        verdict = {
+            "metric": f"fleet_disagg_drill_{role}",
+            "replicas": n, "requests": len(jobs),
+            "lost": errs["lost"], "mismatched": errs["mismatched"],
+            "shed": errs["shed"],
+            "replica_deaths": s["replica_deaths"],
+            "retries": s["retries"], "re_prefills": s["re_prefills"],
+            "migrations": s["migrations"],
+            "duplicates": s["duplicates"],
+            "migration_edge_in_trace": bool(mig_edge),
+            **stitched, "wall_s": round(wall, 2),
+        }
+        print(json.dumps(verdict))
+        return 0 if (verdict["lost"] == 0 and verdict["mismatched"] == 0
+                     and verdict["replica_deaths"] >= 1
+                     and verdict["migrations"] > 0) else 1
+    finally:
+        router.close(stop_replicas=True)
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
 
 
 def _request(i):
@@ -418,6 +757,13 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--drill", action="store_true",
                     help="kill-one-replica acceptance drill")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated prefill/decode TTFT-isolation "
+                         "bench (vs the mixed baseline)")
+    ap.add_argument("--disagg-drill", choices=("prefill", "decode"),
+                    default=None, metavar="ROLE",
+                    help="kill -9 the replica of ROLE mid-stream under "
+                         "disaggregated load")
     ap.add_argument("--replicas", type=int, default=2)
     ap.add_argument("--requests", type=int, default=64)
     ap.add_argument("--fleet-dir", default=None)
@@ -427,6 +773,14 @@ def main():
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(_REPO, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if args.disagg_drill:
+        if args.replicas == 2:
+            args.replicas = 3  # a drill needs a survivor of each role
+        return main_disagg_drill(args, args.disagg_drill)
+    if args.disagg:
+        if args.replicas == 2:
+            args.replicas = 3
+        return main_disagg(args)
     return main_drill(args) if args.drill else main_sweep(args)
 
 

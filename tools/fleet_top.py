@@ -106,9 +106,9 @@ def _slo_cells(doc: Dict) -> List[str]:
 
 
 def rows(docs: List[Tuple[str, str, Optional[Dict]]],
-         slo_on: bool = False) -> List[List[str]]:
+         slo_on: bool = False, role_on: bool = False) -> List[List[str]]:
     out = []
-    ncols = len(header(slo_on))
+    ncols = len(header(slo_on, role_on))
     for label, ep, doc in docs:
         if doc is None:
             out.append([label, ep, "DOWN"] + ["-"] * (ncols - 3))
@@ -136,6 +136,11 @@ def rows(docs: List[Tuple[str, str, Optional[Dict]]],
              f"{_fmt(tr.get('mfu'), '.3f')}"
              if tr.get("steps") else "-"),
         ]
+        if role_on:
+            # disaggregated fleet: this replica's role and migration
+            # rate (pages shipped out + spliced in, per second)
+            row.append(_fmt(eng.get("role")))
+            row.append(_fmt(eng.get("migrations_per_s"), ".1f"))
         if slo_on:
             row.extend(_slo_cells(doc))
         out.append(row)
@@ -144,18 +149,22 @@ def rows(docs: List[Tuple[str, str, Optional[Dict]]],
 
 _HEADER = ["ID", "ENDPOINT", "PID", "KIND", "INFL", "ACTIVE", "CACHE",
            "RATE", "P99MS", "WSTEP", "EPOCH", "GOODPUT/MFU"]
+_ROLE_HEADER = ["ROLE", "MIG/S"]
 _SLO_HEADER = ["SLO", "BURN", "BUDGET", "CANP50", "FLOP/S"]
 
 
-def header(slo_on: bool = False) -> List[str]:
+def header(slo_on: bool = False, role_on: bool = False) -> List[str]:
     """Fleets without an SLO config keep the classic 12-column
     layout; the SLO columns appear only when some process exports a
-    ``slo`` statusz section."""
-    return _HEADER + _SLO_HEADER if slo_on else _HEADER
+    ``slo`` statusz section, and the disaggregation columns
+    (ROLE, MIG/S) only when some replica exports a role."""
+    head = _HEADER + _ROLE_HEADER if role_on else list(_HEADER)
+    return head + _SLO_HEADER if slo_on else head
 
 
-def render(table: List[List[str]], slo_on: bool = False) -> str:
-    head = header(slo_on)
+def render(table: List[List[str]], slo_on: bool = False,
+           role_on: bool = False) -> str:
+    head = header(slo_on, role_on)
     widths = [max(len(str(r[i])) for r in [head] + table)
               for i in range(len(head))]
     lines = ["  ".join(h.ljust(w) for h, w in zip(head, widths))]
@@ -194,7 +203,10 @@ def main(argv=None) -> int:
                   f"{up}/{len(docs)} up")
             slo_on = any(d is not None and d.get("slo")
                          for _, _, d in docs)
-            print(render(rows(docs, slo_on), slo_on))
+            role_on = any(d is not None
+                          and (d.get("engine") or {}).get("role")
+                          for _, _, d in docs)
+            print(render(rows(docs, slo_on, role_on), slo_on, role_on))
         if not args.watch:
             return 0 if docs and any(d for _, _, d in docs) else 1
         time.sleep(args.watch)
