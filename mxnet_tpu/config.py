@@ -497,8 +497,11 @@ register_env(
     "continuation of the most recent earlier occurrence "
     "(deterministic, so fleet decode retries re-propose "
     "identically).  The interface (mxnet_tpu.speculative.Proposer-"
-    "style propose(context, k)) is pluggable for a small draft LM; "
-    "unknown names raise at engine construction.")
+    "style propose(context, k)) is pluggable; 'draft_lm' runs a "
+    "small trained LM as the drafter (weights from "
+    "MXNET_SERVING_DRAFT_CKPT), greedy and deterministic so fleet "
+    "decode retries re-propose identically.  Unknown names raise at "
+    "engine construction.")
 register_env(
     "MXNET_SERVING_PREFILL_CHUNK", 0, int,
     "Chunked-prefill slice size in TOKENS for serving.DecodeEngine "
@@ -545,6 +548,70 @@ register_env(
     "replica child so one host packs several tp-sharded replicas on "
     "disjoint device sets.  Out-of-range ordinals, duplicates, or a "
     "length not equal to tp*pp raise at engine construction.")
+register_env(
+    "MXNET_ADAPTER_ENABLE", 0, int,
+    "1: serving.DecodeEngine builds its executables with the "
+    "per-stream paged-LoRA adapter epilogue (mxnet_tpu.adapters) so "
+    "one engine serves batches mixing tenants — each stream's "
+    "low-rank (A, B) delta is gathered from the adapter pool by slot "
+    "id inside the one fused program; slot 0 is an exact no-op, so "
+    "streams without an adapter stay bit-identical to the "
+    "pre-adapter engine.  0 (default): adapter-free executables, "
+    "byte-identical graphs to before this subsystem existed.  "
+    "Garbage or values other than 0/1 raise at engine construction "
+    "naming this variable.")
+register_env(
+    "MXNET_ADAPTER_SLOTS", 8, int,
+    "Resident adapter slots PER RANK BUCKET in the "
+    "adapters.AdapterPool — the device slab holds slots+1 rows (row "
+    "0 is the reserved null adapter).  Publishing beyond capacity "
+    "LRU-evicts parked (refcount-0) adapters deterministically; a "
+    "request for an evicted adapter re-publishes it from the host "
+    "copy (a pool miss, visible in stats).  Must be >= 1; garbage "
+    "or values < 1 raise at pool construction naming this variable.")
+register_env(
+    "MXNET_ADAPTER_RANK_BUCKETS", "8", str,
+    "Comma-separated LoRA rank buckets (e.g. '4,16') the adapter "
+    "pool allocates slabs for — an adapter of rank r is zero-padded "
+    "into the smallest bucket >= r (numerically exact; padded lanes "
+    "multiply zero rows), keeping the AOT executable matrix finite "
+    "while serving mixed ranks.  Buckets must be positive, strictly "
+    "increasing integers; garbage, non-positive, or unsorted lists "
+    "raise at pool construction naming this variable.")
+register_env(
+    "MXNET_TENANT_QUOTA_TOKENS", 0, int,
+    "Per-tenant token-bucket quota capacity for DecodeEngine "
+    "admission: each submitted request charges prompt + max_new "
+    "tokens against its tenant's bucket; an empty bucket sheds the "
+    "request with a typed QuotaExceededError (reason tenant_quota, "
+    "counted per tenant in stats()/statusz — fairness stays "
+    "auditable).  0 (default): quotas off.  Negative or garbage "
+    "values raise at engine construction naming this variable.")
+register_env(
+    "MXNET_TENANT_QUOTA_REFILL", 0.0, float,
+    "Token-bucket refill rate in tokens/second for "
+    "MXNET_TENANT_QUOTA_TOKENS (0, the default, makes the quota a "
+    "hard per-lifetime cap — useful in tests; production wants a "
+    "positive sustained rate).  Negative or garbage values raise at "
+    "engine construction naming this variable.")
+register_env(
+    "MXNET_SERVING_DRAFT_CKPT", None, str,
+    "Checkpoint directory holding the draft LM's weights for the "
+    "'draft_lm' speculative proposer (MXNET_SERVING_PROPOSER) — the "
+    "newest checkpoint under it loads at engine construction; its "
+    "architecture (layers, d_model, vocab) is inferred from the "
+    "parameter shapes, and head count comes from "
+    "MXNET_SERVING_DRAFT_HEADS.  Unset while the proposer is "
+    "'draft_lm' raises at engine construction naming this variable; "
+    "a missing/empty directory raises too.")
+register_env(
+    "MXNET_SERVING_DRAFT_HEADS", 0, int,
+    "Attention head count of the MXNET_SERVING_DRAFT_CKPT draft LM "
+    "(head count is not recoverable from fused-QKV parameter "
+    "shapes).  0 (default) only while the proposer is not "
+    "'draft_lm'; otherwise must be >= 1 and divide the draft's "
+    "d_model — violations raise at engine construction naming this "
+    "variable.")
 register_env(
     "MXNET_FLEET_REPLICAS", 2, int,
     "Replica-process count for fleet.launch_local_fleet / "

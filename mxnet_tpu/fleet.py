@@ -67,6 +67,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import adapters as _adapters
 from . import profiler
 from . import slo as _slo
 from . import wire
@@ -81,7 +82,7 @@ __all__ = ["Router", "FleetClient", "ShedError", "ReplicaClient",
 # fleet wire ops (a separate op space from ps.py: different servers,
 # same framing)
 (_F_SUBMIT, _F_RESULT, _F_CTRL, _F_CTRL_RESULT,
- _F_MIGRATE) = range(101, 106)
+ _F_MIGRATE, _F_ADAPTER) = range(101, 107)
 
 # disaggregated-serving replica roles ("mixed" = the classic
 # do-everything replica); the fleet is DISAGGREGATED the moment both
@@ -179,6 +180,12 @@ def _pack_spec(spec: Dict[str, Any]) -> bytes:
         # disagg phase byte: 0 = classic end-to-end decode, 1 =
         # prefill-export (the response is a signed KV page frame)
         body += struct.pack("!B", 1 if spec.get("phase") == 1 else 0)
+        # tenancy triplet (PR 20): SLO class rides to the replica so
+        # engine-side admission can tier; tenant/adapter name the
+        # quota bucket and the LoRA slot ("" = not set)
+        body += wire.pack_key(spec.get("slo_class") or "interactive")
+        body += wire.pack_key(spec.get("tenant") or "")
+        body += wire.pack_key(spec.get("adapter") or "")
         body += wire.pack_tensor(
             np.asarray(spec["prompt"], dtype=np.int32))
         return bytes(body)
@@ -208,12 +215,18 @@ def _unpack_spec(buf: memoryview, off: int) -> Dict[str, Any]:
         off += 8
         phase = buf[off]
         off += 1
+        slo_class, off = wire.unpack_key(buf, off)
+        tenant, off = wire.unpack_key(buf, off)
+        adapter, off = wire.unpack_key(buf, off)
         prompt, off = wire.unpack_tensor(buf, off)
         return {"kind": "decode", "prompt": np.array(prompt),
                 "max_new": int(max_new),
                 "temperature": None if temp < 0 else float(temp),
                 "eos": None if eos == _NO_EOS else int(eos),
-                "seed": int(seed), "phase": int(phase)}
+                "seed": int(seed), "phase": int(phase),
+                "slo_class": slo_class or "interactive",
+                "tenant": tenant or None,
+                "adapter": adapter or None}
     raise MXNetError(f"unknown wire request kind {kind}")
 
 
@@ -464,13 +477,21 @@ class ReplicaServer:
                         spec["prompt"], spec["max_new"],
                         temperature=spec["temperature"],
                         eos_id=spec["eos"], seed=spec["seed"],
-                        trace=trace)
+                        trace=trace,
+                        slo_class=spec.get("slo_class",
+                                           "interactive"),
+                        tenant=spec.get("tenant"),
+                        adapter=spec.get("adapter"))
                 else:
                     fut = self.harness.submit_decode(
                         spec["prompt"], spec["max_new"],
                         temperature=spec["temperature"],
                         eos_id=spec["eos"], seed=spec["seed"],
-                        trace=trace)
+                        trace=trace,
+                        slo_class=spec.get("slo_class",
+                                           "interactive"),
+                        tenant=spec.get("tenant"),
+                        adapter=spec.get("adapter"))
             except BaseException as exc:  # noqa: BLE001 — to the wire
                 self._send(sock, wlock, _F_RESULT, rid, _ST_ERR,
                            f"{type(exc).__name__}: {exc}".encode())
@@ -523,6 +544,29 @@ class ReplicaServer:
 
             fut.add_done_callback(mig_done)
             return
+        if op == _F_ADAPTER:
+            # hot LoRA publish: tensors ride the signed page-frame
+            # encoding (never pickle, same HMAC discipline as
+            # migration payloads); runs inline — a slab write is
+            # milliseconds and must not race a second publish of the
+            # same name through another thread
+            try:
+                _trace, off = wire.unpack_trace(buf, 9)
+                meta, arrays = wire.unpack_page_frame(
+                    self._secret, buf[off:], "adapter frame (publish)")
+                if len(arrays) != 2:
+                    raise MXNetError(
+                        f"adapter frame carries {len(arrays)} arrays; "
+                        "expected [a, b]")
+                slot = self.harness.publish_adapter(
+                    meta["name"], arrays[0], arrays[1],
+                    alpha=meta.get("alpha"))
+                self._send(sock, wlock, _F_RESULT, rid, _ST_OK,
+                           json.dumps({"slot": int(slot)}).encode())
+            except BaseException as exc:  # noqa: BLE001 — to the wire
+                self._send(sock, wlock, _F_RESULT, rid, _ST_ERR,
+                           f"{type(exc).__name__}: {exc}".encode())
+            return
         if op == _F_CTRL:
             try:
                 _trace, off = wire.unpack_trace(buf, 9)
@@ -560,6 +604,9 @@ class ReplicaServer:
             elif op == "role":
                 self.harness.set_role(spec["role"])
                 out = {"ok": True, "role": spec["role"]}
+            elif op == "retire_adapter":
+                out = {"freed": bool(
+                    self.harness.retire_adapter(spec["name"]))}
             elif op == "stop":
                 out = {"ok": True}
                 self._closing.set()
@@ -662,6 +709,28 @@ class ReplicaClient:
 
     def set_role(self, role: str) -> Dict:
         return self._ctrl({"op": "role", "role": role})
+
+    def publish_adapter(self, name, a, b, alpha=None) -> int:
+        """Hot LoRA publish over the wire: the (A, B) slabs ride the
+        signed page-frame encoding (no drain on the replica — see
+        :meth:`ReplicaHarness.publish_adapter`).  Returns the slot."""
+        meta = {"name": str(name),
+                "alpha": None if alpha is None else float(alpha)}
+        body = wire.pack_trace(None) + wire.pack_page_frame(
+            self._secret, meta, [np.asarray(a), np.asarray(b)])
+
+        def parse(status, payload):
+            if status != _ST_OK:
+                return MXNetError(
+                    bytes(payload).decode(errors="replace"))
+            return json.loads(bytes(payload).decode())
+
+        return int(self._dx.begin(_F_ADAPTER, body, parse)
+                   .result(300.0)["slot"])
+
+    def retire_adapter(self, name) -> bool:
+        return bool(self._ctrl({"op": "retire_adapter",
+                                "name": str(name)})["freed"])
 
     def _ctrl(self, obj: Dict, timeout: float = 120.0) -> Dict:
         def parse(status, payload):
@@ -845,10 +914,10 @@ class _Ticket:
                  "queued", "trace", "t_enqueue", "tp_submit",
                  "tp_dispatch", "trace_owned", "slo_class", "canary",
                  "phase", "spec0", "failures", "prefill_rid",
-                 "tp_prefill_done", "mig_pages")
+                 "tp_prefill_done", "mig_pages", "tenant")
 
     def __init__(self, tid, spec, deadline, units, future, trace=None,
-                 slo_class="interactive", canary=False):
+                 slo_class="interactive", canary=False, tenant=None):
         self.tid = tid
         self.spec = spec
         self.deadline = deadline      # absolute monotonic, or None
@@ -869,6 +938,7 @@ class _Ticket:
         self.trace_owned = False  # router created the root span
         self.slo_class = slo_class  # validated at _accept()
         self.canary = canary        # excluded from request counters
+        self.tenant = tenant        # quota bucket / fairness key
         # disaggregated serving: 0 = classic end-to-end dispatch,
         # 1 = prefill-export in flight, 2 = page migration / decode
         # continuation in flight.  ANY retry resets to 1 with spec0
@@ -942,7 +1012,8 @@ class Router:
                  replica_depth: int = 8, max_pending: int = 1024,
                  dead_timeout: Optional[float] = None,
                  roles: Optional[Sequence[str]] = None,
-                 autoscale: Optional[bool] = None):
+                 autoscale: Optional[bool] = None,
+                 tenant_quota=None):
         if not replicas:
             raise MXNetError("Router needs at least one replica")
         self._fleet_dir = fleet_dir
@@ -1008,6 +1079,13 @@ class Router:
 
         self._shed_times = _collections.deque(maxlen=_SHED_BURST_COUNT)
         self._last_shed_dump = 0.0
+        # multi-tenancy: accept-side token quotas (kwarg wins, else
+        # MXNET_TENANT_QUOTA_TOKENS/_REFILL) + per-tenant fairness
+        # counters the /statusz tenants section renders
+        self._quota = tenant_quota if tenant_quota is not None \
+            else _adapters.quota_from_env()
+        self._tenants: Dict[str, Dict[str, float]] = {}
+        self._adapters: set = set()  # names published via this router
         self._swap_lock = threading.Lock()  # one rolling swap at a time
         self._weights_step = -1
 
@@ -1069,6 +1147,11 @@ class Router:
         self._metrics.inc(name, value)
         profiler.inc_counter(f"fleet.{name}", value)
 
+    def _tenant_count(self, tenant, name, value=1):
+        with self._lock:
+            d = self._tenants.setdefault(tenant, {})
+            d[name] = d.get(name, 0) + value
+
     def _set_alive_gauge(self):
         profiler.set_gauge(
             "fleet.replicas_alive",
@@ -1091,18 +1174,25 @@ class Router:
                  eos_id=None, deadline_ms: Optional[float] = None,
                  seed: Optional[int] = None, trace=None,
                  slo_class: str = "interactive",
-                 canary: bool = False) -> Future:
+                 canary: bool = False, tenant=None,
+                 adapter=None) -> Future:
         """Route one generation; the Future resolves to the np.int32
         generated tokens.  ``slo_class`` keys the burn-rate windows the
         delivery outcome lands in; ``canary=True`` marks a synthetic
-        probe (full routed path, excluded from ``fleet.requests``)."""
+        probe (full routed path, excluded from ``fleet.requests``).
+        ``tenant`` names the quota/fairness bucket (sheds typed
+        ``tenant_quota`` when its token budget runs dry); ``adapter``
+        names a published LoRA adapter the replicas apply to this
+        stream."""
         spec = {"kind": "decode",
                 "prompt": np.asarray(prompt, dtype=np.int32),
                 "max_new": int(max_new_tokens), "temperature": temperature,
-                "eos": eos_id, "seed": 0}
+                "eos": eos_id, "seed": 0, "slo_class": slo_class,
+                "tenant": None if tenant is None else str(tenant),
+                "adapter": None if adapter is None else str(adapter)}
         return self._accept(spec, deadline_ms, units=int(max_new_tokens),
                             seed=seed, trace=trace, slo_class=slo_class,
-                            canary=canary)
+                            canary=canary, tenant=spec["tenant"])
 
     @staticmethod
     def _infer_units(inputs) -> int:
@@ -1113,8 +1203,26 @@ class Router:
 
     def _accept(self, spec, deadline_ms, units, seed=None,
                 trace=None, slo_class="interactive",
-                canary=False) -> Future:
+                canary=False, tenant=None) -> Future:
         _slo.check_class(slo_class)
+        if self._quota is not None and tenant is not None \
+                and not canary:
+            # accept-side quota: shed BEFORE the ticket takes queue
+            # space — typed, so clients and dashboards can tell a
+            # budget problem from an overload problem
+            tokens = int(units)
+            if spec["kind"] == "decode":
+                tokens += int(np.asarray(spec["prompt"]).size)
+            try:
+                self._quota.charge(tenant, tokens)
+            except _adapters.QuotaExceededError as exc:
+                self._count("shed")
+                self._count("shed_tenant_quota")
+                self._tenant_count(tenant, "shed")
+                self._note_shed()
+                raise ShedError(
+                    f"request shed (tenant_quota): {exc}",
+                    reason="tenant_quota") from None
         fut: Future = Future()
         with self._cond:
             if not self._alive:
@@ -1139,13 +1247,16 @@ class Router:
                 trace = profiler.make_trace(key=tid)
                 owned = trace is not None
             t = _Ticket(tid, spec, deadline, max(1, units), fut,
-                        trace=trace, slo_class=slo_class, canary=canary)
+                        trace=trace, slo_class=slo_class, canary=canary,
+                        tenant=tenant)
             t.trace_owned = owned
             self._pending.append(t)
             profiler.set_gauge("fleet.pending", len(self._pending))
             self._cond.notify_all()
         if not canary:  # probes keep request counters honest
             self._count("requests")
+            if tenant is not None:
+                self._tenant_count(tenant, "requests")
         return fut
 
     # -- cost model -----------------------------------------------------
@@ -1324,14 +1435,22 @@ class Router:
                         victim, "overload",
                         f"router queue over {self._max_pending}; "
                         "oldest-deadline-first shed")
-                # 3) assign FIFO; a head that no replica can take means
-                #    the fleet is at depth — hold the line
+                # 3) assign FIFO within an SLO tier: the first
+                #    interactive ticket jumps the batch queue
+                #    (admission-level preemption); a head that no
+                #    replica can take means the fleet is at depth —
+                #    hold the line
                 while self._pending:
-                    t = self._pending[0]
+                    pick = 0
+                    for i, cand in enumerate(self._pending):
+                        if cand.slo_class == "interactive":
+                            pick = i
+                            break
+                    t = self._pending[pick]
                     state, unmeetable = self._eligible(t)
                     if state is None:
                         if unmeetable:
-                            self._pending.pop(0)
+                            self._pending.pop(pick)
                             t.queued = False
                             self._shed_locked(
                                 t, "deadline",
@@ -1341,7 +1460,7 @@ class Router:
                                 "per-bucket cost model)")
                             continue
                         break
-                    self._pending.pop(0)
+                    self._pending.pop(pick)
                     t.queued = False
                     t.rid = state.handle.rid
                     t.attempts += 1
@@ -1422,6 +1541,11 @@ class Router:
         t.queued = False
         self._count("shed")
         self._count(f"shed_{reason}")
+        if t.tenant is not None:
+            # caller holds the router lock; bump inline rather than
+            # through _tenant_count (which would re-acquire it)
+            d = self._tenants.setdefault(t.tenant, {})
+            d["shed"] = d.get("shed", 0) + 1
         if not t.canary:  # a shed request spent availability budget
             self._slo.observe_avail(t.slo_class, False)
         if t.trace is not None:
@@ -1831,6 +1955,75 @@ class Router:
                     "replicas": reports,
                     "total_ms": (time.monotonic() - t0) * 1e3}
 
+    # -- multi-tenant adapters ------------------------------------------
+    def publish_adapter(self, name, a, b, alpha=None) -> Dict:
+        """Broadcast one LoRA adapter to every live replica — HOT,
+        unlike :meth:`swap_weights`: no drain, no dispatch pause (each
+        engine's publish is a slab write plus one atomic reference
+        swap; in-flight streams are untouched).  Returns the per-rid
+        slot map.  If ANY replica refuses, the successes are rolled
+        back (retired) and the error raises — an adapter is routable
+        only when the whole fleet can serve it."""
+        name = str(name)
+        a = np.asarray(a)
+        b = np.asarray(b)
+        with self._cond:
+            handles = {rid: s.handle
+                       for rid, s in self._replicas.items()
+                       if not s.dead}
+        slots: Dict[int, int] = {}
+        errors: Dict[int, BaseException] = {}
+        for rid, handle in sorted(handles.items()):
+            try:
+                slots[rid] = int(handle.publish_adapter(
+                    name, a, b, alpha=alpha))
+            except BaseException as exc:  # noqa: BLE001 — collected
+                errors[rid] = exc
+        if errors:
+            for rid in slots:  # roll the partial publish back
+                try:
+                    handles[rid].retire_adapter(name)
+                except BaseException:  # noqa: BLE001 — best effort
+                    pass
+            detail = "; ".join(f"rid {rid}: {exc}"
+                               for rid, exc in sorted(errors.items()))
+            raise MXNetError(
+                f"publish_adapter({name!r}) failed on "
+                f"{len(errors)}/{len(handles)} replica(s) — rolled "
+                f"back: {detail}")
+        with self._lock:
+            self._adapters.add(name)
+        self._count("adapter_publishes")
+        return {"name": name, "slots": slots}
+
+    def retire_adapter(self, name) -> Dict:
+        """Broadcast an adapter retire — also hot.  Replicas with live
+        references defer the actual free to the last holder's
+        retirement; the name stops being acquirable fleet-wide
+        immediately.  Returns {rid: freed-now bool}."""
+        name = str(name)
+        with self._cond:
+            handles = {rid: s.handle
+                       for rid, s in self._replicas.items()
+                       if not s.dead}
+        freed: Dict[int, bool] = {}
+        errors: Dict[int, BaseException] = {}
+        for rid, handle in sorted(handles.items()):
+            try:
+                freed[rid] = bool(handle.retire_adapter(name))
+            except BaseException as exc:  # noqa: BLE001 — collected
+                errors[rid] = exc
+        with self._lock:
+            self._adapters.discard(name)
+        self._count("adapter_retires")
+        if errors:
+            detail = "; ".join(f"rid {rid}: {exc}"
+                               for rid, exc in sorted(errors.items()))
+            raise MXNetError(
+                f"retire_adapter({name!r}) failed on "
+                f"{len(errors)}/{len(handles)} replica(s): {detail}")
+        return {"name": name, "freed": freed}
+
     # -- disaggregated roles --------------------------------------------
     def set_role(self, rid: int, role: str,
                  drain_timeout: Optional[float] = None) -> Dict:
@@ -2013,6 +2206,17 @@ class Router:
             out["disagg"] = self._roles_on and self._disagg_live()
         out["alive"] = self.alive_replicas()
         out["weights_step"] = self._weights_step
+        # multi-tenancy: per-tenant fairness (requests/shed at the
+        # router's own increment sites) + quota balances; fleet_top
+        # renders this section only when it is non-empty
+        out["shed_tenant_quota"] = int(c.get("shed_tenant_quota", 0))
+        with self._lock:
+            out["tenants"] = {t: dict(d)
+                              for t, d in self._tenants.items()}
+        if self._quota is not None:
+            for t, q in self._quota.stats().items():
+                out["tenants"].setdefault(t, {}).update(q)
+        out["adapters_published"] = sorted(self._adapters)
         out["cost_model_ms"] = {f"{k}:{b}": round(v, 3)
                                 for (k, b), v in sorted(self._cost.items())}
         out["latency_breakdown"] = self.latency_breakdown()
@@ -2103,7 +2307,11 @@ class Router:
                         eos_id=spec["eos"],
                         deadline_ms=deadline_ms,
                         seed=spec["seed"] or None,
-                        trace=trace)
+                        trace=trace,
+                        slo_class=spec.get("slo_class",
+                                           "interactive"),
+                        tenant=spec.get("tenant"),
+                        adapter=spec.get("adapter"))
             except ShedError as exc:
                 send(_F_RESULT, _ST_SHED, f"{exc.reason}: {exc}".encode())
                 return
@@ -2224,10 +2432,12 @@ class FleetClient:
 
     def generate(self, prompt, max_new_tokens=32, temperature=None,
                  eos_id=None, deadline_ms: Optional[float] = None,
-                 trace=None) -> Future:
+                 trace=None, slo_class="interactive", tenant=None,
+                 adapter=None) -> Future:
         spec = {"kind": "decode", "prompt": prompt,
                 "max_new": max_new_tokens, "temperature": temperature,
-                "eos": eos_id, "seed": 0}
+                "eos": eos_id, "seed": 0, "slo_class": slo_class,
+                "tenant": tenant, "adapter": adapter}
         fut = self._begin_submit(spec, deadline_ms, trace)
         # decode result is ONE token tensor, not a list
         out: Future = Future()

@@ -176,9 +176,16 @@ class BlockAllocator:
     queue, or shrink).  Pages come back at refcount 1; ``share``
     attaches another holder, ``release`` detaches one.  A released
     page either returns to the free list or — ``park=True`` — keeps
-    its bytes as reclaimable cache."""
+    its bytes as reclaimable cache.
 
-    def __init__(self, num_blocks: int, block_tokens: int):
+    ``gauge_prefix`` names the profiler gauge family this allocator
+    maintains (default: the KV pool's ``serving.cache*``).  A second
+    allocator in the same process — the LoRA adapter-slot pool reuses
+    this exact machinery with "pages" = adapter slots — must pass its
+    own prefix or the two would silently clobber each other's gauges."""
+
+    def __init__(self, num_blocks: int, block_tokens: int,
+                 gauge_prefix: str = "serving"):
         if num_blocks < 2:
             raise MXNetError(
                 f"BlockAllocator needs >= 2 blocks (1 scratch + 1 "
@@ -187,6 +194,7 @@ class BlockAllocator:
             raise MXNetError(f"bad block_tokens {block_tokens}")
         self.num_blocks = int(num_blocks)
         self.block_tokens = int(block_tokens)
+        self._gauge_prefix = str(gauge_prefix)
         # LIFO free list: recently-freed (likely still cache-warm)
         # pages are reused first
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
@@ -390,9 +398,10 @@ class BlockAllocator:
 
     # ------------------------------------------------------------------
     def _update_gauges(self):
-        profiler.set_gauge("serving.cache_blocks_used", self.used_blocks)
-        profiler.set_gauge("serving.cache_blocks_free", self.free_blocks)
-        profiler.set_gauge("serving.cache_blocks_cached",
+        pre = self._gauge_prefix
+        profiler.set_gauge(f"{pre}.cache_blocks_used", self.used_blocks)
+        profiler.set_gauge(f"{pre}.cache_blocks_free", self.free_blocks)
+        profiler.set_gauge(f"{pre}.cache_blocks_cached",
                            self.parked_blocks)
-        profiler.set_gauge("serving.shared_blocks", self.shared_blocks)
-        profiler.set_gauge("serving.cache_util", self.utilization())
+        profiler.set_gauge(f"{pre}.shared_blocks", self.shared_blocks)
+        profiler.set_gauge(f"{pre}.cache_util", self.utilization())

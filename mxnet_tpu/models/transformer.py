@@ -168,15 +168,31 @@ def get_symbol(vocab_size=10000, seq_len=128, num_layers=4, num_heads=4,
 # ---------------------------------------------------------------------------
 
 
-def _decode_block(x, d_model, num_heads, d_ff, name, kv_block, attend):
+def _decode_block(x, d_model, num_heads, d_ff, name, kv_block, attend,
+                  lora=(), layer=0):
     """One pre-LN transformer block with the attention sublayer
-    replaced by ``attend(qkv) -> (att_out, *cache_outs)``."""
+    replaced by ``attend(qkv) -> (att_out, *cache_outs)``.
+
+    ``lora``: rank buckets (ints).  Each bucket adds a per-stream
+    LoRA epilogue on the fused QKV projection — the adapter slabs
+    ``adapter_a_r{rb}``/``adapter_b_r{rb}`` (N, L, d, rb)/(N, L, rb,
+    3d) are gathered by the ``adapter_slots_r{rb}`` (B,) id vector,
+    slot 0 selecting the base bits exactly (``ops/adapter.py``).  An
+    empty tuple builds the pre-adapter graph byte-identically."""
     h = sym.LayerNorm(x, name=f"{name}_ln1")
     qkv = sym.FullyConnected(
         h, num_hidden=3 * d_model, flatten=False, name=f"{name}_qkv",
         weight=sym.Variable(f"{name}_qkv_weight",
                             attr=logical_axes("qkv", "embed")),
         bias=sym.Variable(f"{name}_qkv_bias", attr=logical_axes("qkv")))
+    for rb in (lora or ()):
+        # a stream lives in at most one bucket (slot 0 elsewhere), so
+        # chaining buckets is exact: slot-0 rows pass base bits through
+        qkv = sym.LoraGatherDelta(
+            qkv, h, sym.Variable(f"adapter_a_r{rb}"),
+            sym.Variable(f"adapter_b_r{rb}"),
+            sym.Variable(f"adapter_slots_r{rb}"),
+            layer=layer, name=f"{name}_lora_r{rb}")
     att, cache_outs = attend(qkv)
     att = sym.FullyConnected(
         att, num_hidden=d_model, flatten=False, name=f"{name}_proj",
@@ -215,7 +231,7 @@ def kv_scale_var(name: str):
 
 
 def _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block, attend_for,
-              vocab_size):
+              vocab_size, lora=None):
     """Embedding -> blocks -> ln_f -> head logits, with per-layer
     attention provided by ``attend_for(layer_idx)``."""
     d_ff = d_ff or 4 * d_model
@@ -233,7 +249,7 @@ def _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block, attend_for,
     for i in range(num_layers):
         x, cache_outs = _decode_block(x, d_model, num_heads, d_ff,
                                       f"layer{i}", kv_block,
-                                      attend_for(i))
+                                      attend_for(i), lora=lora, layer=i)
         caches.extend(cache_outs)
     x = sym.LayerNorm(x, name="ln_f")
     logits = sym.FullyConnected(
@@ -254,7 +270,7 @@ def _kv_quant(kv_dtype):
 
 def transformer_lm_prefill(vocab_size, num_layers=4, num_heads=4,
                            d_model=128, d_ff=None, kv_block=16,
-                           paged=True, kv_dtype="fp32"):
+                           paged=True, kv_dtype="fp32", lora=None):
     """Prefill symbol: the full causal forward over a (padded) prompt
     that ALSO writes each layer's K/V state into the cache.
 
@@ -300,12 +316,12 @@ def transformer_lm_prefill(vocab_size, num_layers=4, num_heads=4,
         return attend
 
     return _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block,
-                     attend_for, vocab_size)
+                     attend_for, vocab_size, lora=lora)
 
 
 def transformer_lm_prefix_prefill(vocab_size, num_layers=4, num_heads=4,
                                   d_model=128, d_ff=None, kv_block=16,
-                                  kv_dtype="fp32"):
+                                  kv_dtype="fp32", lora=None):
     """Suffix-prefill symbol for a prefix-cache hit: the forward runs
     ONLY over the uncached suffix of the prompt, attending the shared
     prefix through the paged cache.
@@ -343,12 +359,12 @@ def transformer_lm_prefix_prefill(vocab_size, num_layers=4, num_heads=4,
         return attend
 
     return _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block,
-                     attend_for, vocab_size)
+                     attend_for, vocab_size, lora=lora)
 
 
 def transformer_lm_verify(vocab_size, num_layers=4, num_heads=4,
                           d_model=128, d_ff=None, kv_block=16,
-                          kv_dtype="fp32"):
+                          kv_dtype="fp32", lora=None):
     """Speculative-verify symbol: W = 1 + k tokens per stream per step
     against the paged KV cache — the multi-query decode step that
     scores the pending token plus k draft tokens in ONE program.
@@ -387,12 +403,12 @@ def transformer_lm_verify(vocab_size, num_layers=4, num_heads=4,
         return attend
 
     return _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block,
-                     attend_for, vocab_size)
+                     attend_for, vocab_size, lora=lora)
 
 
 def transformer_lm_decode(vocab_size, num_layers=4, num_heads=4,
                           d_model=128, d_ff=None, kv_block=16,
-                          paged=True, kv_dtype="fp32"):
+                          paged=True, kv_dtype="fp32", lora=None):
     """Decode-mode symbol: ONE token per stream per step against the
     KV cache.
 
@@ -435,4 +451,4 @@ def transformer_lm_decode(vocab_size, num_layers=4, num_heads=4,
         return attend
 
     return _lm_trunk(num_layers, num_heads, d_model, d_ff, kv_block,
-                     attend_for, vocab_size)
+                     attend_for, vocab_size, lora=lora)

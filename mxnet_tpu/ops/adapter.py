@@ -1,0 +1,77 @@
+"""Paged LoRA adapter epilogue op (multi-tenant serving).
+
+One registered op, ``LoraGatherDelta``, is the whole device side of
+S-LoRA/Punica-style multi-tenant serving (Sheng et al. '23, Chen et
+al. '23): every stream in a decode/verify/prefill batch carries an
+**adapter slot id**, and the epilogue adds that stream's low-rank
+delta to the base projection INSIDE the one fused program —
+
+    out[b] = base[b] + (h[b] @ A[slot_b, layer]) @ B[slot_b, layer]
+
+so a single bucketed executable serves batches that mix tenants.  The
+``alpha / r`` LoRA scale is folded into the B slab at publish time
+(``mxnet_tpu.adapters.AdapterPool``), keeping the op a pure two-matmul
+epilogue.
+
+Numerics contract (what the serving tests pin):
+
+* **slot 0 is the null adapter** — its slab rows are all-zero AND the
+  op selects the raw ``base`` lanes for slot-0 streams with a
+  ``where``, so a non-LoRA stream's logits are BIT-identical to the
+  pre-adapter engine's (not merely "plus exact zero", which IEEE
+  ``-0.0 + 0.0`` would already break);
+* the base projection is untouched — the delta is computed from the
+  SAME ``h`` the base matmul consumed and added afterwards, so
+  enabling adapters never re-associates the base accumulation (the
+  PR-16 ULP lesson: any in-program derivation of a matmul operand
+  changes its bits);
+* rank buckets zero-pad: an adapter of rank r published into a bucket
+  rb > r contributes exactly the same delta (the padded lanes multiply
+  zero B rows).
+
+The op is deliberately plain XLA — a gather feeding two batched
+matmuls fuses fine and the MXU sees (B*S, d) x (d, r) work; a Pallas
+kernel buys nothing at LoRA ranks (r <= 64, tiny inner dim).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import attr_int
+from .registry import register
+
+__all__ = []
+
+
+def _lora_infer(attrs, in_shapes):
+    base, h, a_slab, b_slab, slots = in_shapes
+    if base is None:
+        return in_shapes, [None], []
+    return in_shapes, [tuple(base)], []
+
+
+@register("LoraGatherDelta",
+          arg_names=("base", "h", "a_slab", "b_slab", "slots"),
+          out_names=("output",),
+          infer_shape=_lora_infer,
+          doc="Per-stream LoRA adapter epilogue: base (B, S, d_out) "
+              "projection output, h (B, S, d_in) the SAME pre-"
+              "projection activations, a_slab (N, L, d_in, rb) / "
+              "b_slab (N, L, rb, d_out) adapter slot slabs (row 0 = "
+              "null adapter, zeros; alpha/r scale folded into B at "
+              "publish), slots (B,) int32 per-stream slot ids -> "
+              "base + (h @ A[slot, layer]) @ B[slot, layer].  Slot-0 "
+              "rows return the base lanes bitwise (where-select, not "
+              "+0.0).  attrs: layer — which slab layer this call "
+              "gathers.")
+def _lora_gather_delta(op_ctx, attrs, inputs, aux):
+    base, h, a_slab, b_slab, slots = inputs
+    layer = attr_int(attrs.get("layer", 0), 0)
+    slots = slots.astype(jnp.int32)
+    a = a_slab[slots, layer]                  # (B, d_in, rb)
+    b = b_slab[slots, layer]                  # (B, rb, d_out)
+    hA = jnp.einsum("bsd,bdr->bsr", h.astype(a.dtype), a)
+    delta = jnp.einsum("bsr,brD->bsD", hA, b).astype(base.dtype)
+    live = (slots > 0)[:, None, None]
+    return [jnp.where(live, base + delta, base)]

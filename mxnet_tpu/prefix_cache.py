@@ -102,24 +102,49 @@ class PrefixIndex:
     def __len__(self) -> int:
         return self._nodes
 
-    def _keys(self, tokens: np.ndarray, nblocks: int) -> List[bytes]:
+    @staticmethod
+    def _salted(salt: bytes) -> bytes:
+        # length-prefixed so no (salt, block-bytes) pair can collide
+        # with another salt's — or with the unsalted tree, whose root
+        # keys are exactly block_tokens * 4 bytes
+        return len(salt).to_bytes(4, "big") + salt if salt else b""
+
+    def _keys(self, tokens: np.ndarray, nblocks: int,
+              salt: bytes = b"") -> List[bytes]:
         t = np.ascontiguousarray(np.asarray(tokens, np.int32))
         B = self.block_tokens
-        return [t[j * B:(j + 1) * B].tobytes() for j in range(nblocks)]
+        keys = [t[j * B:(j + 1) * B].tobytes() for j in range(nblocks)]
+        if salt and keys:
+            # namespace the tree at its ROOT block: K/V bytes depend on
+            # the (tokens, adapter) pair, not tokens alone — a prefix
+            # prefilled under LoRA adapter X must never satisfy a
+            # stream of adapter Y (or a plain stream)
+            keys[0] = self._salted(salt) + keys[0]
+        return keys
+
+    def roots_for(self, salt: bytes) -> List[_Node]:
+        """Depth-0 nodes living under ``salt``'s namespace — the
+        handles an adapter republish uses to drop every chain whose
+        bytes were computed under the name's OLD weights."""
+        p = self._salted(salt)
+        want = len(p) + self.block_tokens * 4
+        return [n for k, n in list(self._root.items())
+                if len(k) == want and k.startswith(p)]
 
     def _touch(self, node: _Node) -> None:
         self._clock += 1
         node.stamp = self._clock
 
     # ------------------------------------------------------------------
-    def match(self, tokens, touch: bool = True) -> List[_Node]:
+    def match(self, tokens, touch: bool = True,
+              salt: bytes = b"") -> List[_Node]:
         """Longest cached block-aligned prefix of ``tokens``: the node
         chain, shallowest first (``len(chain) * block_tokens`` cached
         tokens).  ``touch`` refreshes the chain's LRU stamps."""
         nblocks = len(tokens) // self.block_tokens
         chain: List[_Node] = []
         children = self._root
-        for key in self._keys(tokens, nblocks):
+        for key in self._keys(tokens, nblocks, salt):
             node = children.get(key)
             if node is None:
                 break
@@ -130,8 +155,8 @@ class PrefixIndex:
                 self._touch(node)
         return chain
 
-    def insert(self, tokens, pages: List[int],
-               nblocks: int) -> List[_Node]:
+    def insert(self, tokens, pages: List[int], nblocks: int,
+               salt: bytes = b"") -> List[_Node]:
         """Map the first ``nblocks`` full blocks of ``tokens`` to
         ``pages[j]``.  Existing nodes keep THEIR page (the content is
         identical by construction; the caller's duplicate page simply
@@ -140,7 +165,7 @@ class PrefixIndex:
         created: List[_Node] = []
         children = self._root
         parent: Optional[_Node] = None
-        for j, key in enumerate(self._keys(tokens, nblocks)):
+        for j, key in enumerate(self._keys(tokens, nblocks, salt)):
             node = children.get(key)
             if node is None:
                 node = _Node(key, int(pages[j]), parent)
@@ -212,22 +237,24 @@ class PrefixCache:
         self.evictions = 0
 
     # -- admission ------------------------------------------------------
-    def peek(self, tokens) -> Tuple[int, int]:
+    def peek(self, tokens, salt: bytes = b"") -> Tuple[int, int]:
         """(cached_tokens, parked_matched) for the longest cached
         prefix — refcounts untouched, stamps untouched (a peek that
         doesn't admit must not distort LRU order).  ``parked_matched``
         pages revive on attach, so they are NOT spare capacity for the
-        admission check."""
-        chain = self.index.match(tokens, touch=False)
+        admission check.  ``salt`` namespaces the lookup (the stream's
+        adapter identity — adapted K/V never crosses tenants)."""
+        chain = self.index.match(tokens, touch=False, salt=salt)
         parked = sum(1 for n in chain if self.allocator.is_parked(n.page))
         return len(chain) * self.index.block_tokens, parked
 
-    def attach(self, tokens, owner=None) -> Tuple[int, List[int]]:
+    def attach(self, tokens, owner=None,
+               salt: bytes = b"") -> Tuple[int, List[int]]:
         """Acquire the longest cached prefix for a new stream: bump
         each chain page's refcount (reviving parked ones) and return
         (cached_tokens, pages).  Counted as ONE prefix hit when
         anything matched."""
-        chain = self.index.match(tokens, touch=True)
+        chain = self.index.match(tokens, touch=True, salt=salt)
         pages = []
         for node in chain:
             if self.allocator.is_parked(node.page):
@@ -244,7 +271,8 @@ class PrefixCache:
         return cached, pages
 
     # -- registration ---------------------------------------------------
-    def register(self, tokens, pages: List[int]) -> None:
+    def register(self, tokens, pages: List[int],
+                 salt: bytes = b"") -> None:
         """Index every FULL block of ``tokens`` (held by the calling
         stream as ``pages``).  Blocks already indexed keep the
         incumbent page; the caller's duplicate stays private."""
@@ -253,7 +281,7 @@ class PrefixCache:
             raise MXNetError(
                 f"register: {nblocks} full blocks but only "
                 f"{len(pages)} pages")
-        for node in self.index.insert(tokens, pages, nblocks):
+        for node in self.index.insert(tokens, pages, nblocks, salt):
             self._page_node[node.page] = node
 
     # -- release / eviction ---------------------------------------------
@@ -285,6 +313,18 @@ class PrefixCache:
             self._page_node.pop(n.page, None)
             if self.allocator.is_parked(n.page):
                 self.allocator.reclaim(n.page)
+
+    def invalidate_salt(self, salt: bytes) -> int:
+        """Drop every cached chain in ``salt``'s namespace (adapter
+        publish/retire): after a retire-then-republish the name maps
+        to NEW weights, so chains prefilled under the old ones must
+        stop being matchable.  Pages still held by (retiring) live
+        streams merely lose their index entry; parked ones are
+        reclaimed.  Returns the number of root chains dropped."""
+        roots = self.index.roots_for(salt) if salt else []
+        for node in roots:
+            self._drop_chain(node)
+        return len(roots)
 
     def detach(self, pages: List[int]) -> int:
         """Un-index pages about to be EXPORTED (live KV migration): a

@@ -45,6 +45,7 @@ import numpy as np
 from .base import MXNetError, get_env
 from . import profiler
 from . import slo as _slo
+from .adapters import QuotaExceededError
 from .chaos import get_chaos
 
 __all__ = ["InferenceEngine", "DecodeEngine", "EngineClosedError",
@@ -902,6 +903,15 @@ def _read_env_buckets(name, default):
     return vals
 
 
+def _prefix_salt(s) -> bytes:
+    """Prefix-cache namespace for a stream: adapted K/V is a function
+    of (tokens, adapter), so each adapter gets its own radix subtree —
+    a prefix prefilled under LoRA adapter X must never satisfy a plain
+    stream or one of adapter Y.  Plain streams share the unsalted
+    tree, bit-compatible with the pre-adapter cache."""
+    return s.adapter.encode("utf-8") if s.adapter else b""
+
+
 class _Stream:
     """One in-flight generation: host-side state the scheduler owns."""
 
@@ -909,10 +919,12 @@ class _Stream:
                  "seed", "generated", "blocks", "length", "next_token",
                  "resume", "t_submit", "t_admit", "trace", "t_enqueue",
                  "cached_len", "await_first", "t_chunk0", "slo_class",
-                 "canary", "cost", "migrate")
+                 "canary", "cost", "migrate", "tenant", "adapter",
+                 "adapter_bucket", "adapter_slot")
 
     def __init__(self, sid, prompt, max_new, temp, eos, future, seed,
-                 trace=None, slo_class="interactive", canary=False):
+                 trace=None, slo_class="interactive", canary=False,
+                 tenant=None, adapter=None):
         self.sid = sid
         self.prompt = prompt          # np.int32 (P,)
         self.max_new = max_new
@@ -935,7 +947,12 @@ class _Stream:
         self.slo_class = slo_class    # validated at submit()
         self.canary = canary          # excluded from request counters
         self.migrate = False          # prefill-only: export after TTFT
-        self.cost = _slo.CostRecord(sid, slo_class, canary)
+        self.tenant = tenant          # quota + cost-attribution key
+        self.adapter = adapter        # published adapter name | None
+        self.adapter_bucket = None    # rank bucket (set on acquire)
+        self.adapter_slot = None      # pool slot id (set on acquire)
+        self.cost = _slo.CostRecord(sid, slo_class, canary,
+                                    tenant=tenant, adapter_id=adapter)
         self.cost.prompt_tokens = int(prompt.size)
 
     def prefill_seq(self) -> np.ndarray:
@@ -1022,7 +1039,8 @@ class DecodeEngine:
                  eos_id=None, ctx=None, donate=None, dtype="float32",
                  kv_dtype=None, prefix_cache=None, evict_policy=None,
                  spec_tokens=None, proposer=None, prefill_chunk=None,
-                 tp=None, pp=None, devices=None, prewarm=False):
+                 tp=None, pp=None, devices=None, prewarm=False,
+                 adapters=None, tenant_quota=None):
         import jax
 
         from .kv_cache import (BlockAllocator, blocks_for_tokens,
@@ -1236,6 +1254,7 @@ class DecodeEngine:
         self._prefix = PrefixCache(self._alloc,
                                    policy=self._evict_policy) \
             if self._prefix_on else None
+        self._prefix_dirty: List[bytes] = []  # queued salt drops
 
         # -- bucket ladders ---------------------------------------------
         self._decode_buckets = tuple(
@@ -1281,11 +1300,58 @@ class DecodeEngine:
                 f"prefill bucket {self._prefill_buckets[-1]} — chunks "
                 f"are bucketed through the prefill ladder")
 
+        # -- paged LoRA adapters + per-tenant quotas ---------------------
+        # (the multi-tenancy layer; mxnet_tpu/adapters.py)
+        from . import adapters as _adapters
+        if adapters is None:
+            adapters = _adapters.adapters_enabled()
+        if adapters is True:
+            adapters = _adapters.pool_from_env(self._L, int(d_model))
+        elif adapters is False:
+            adapters = None
+        if adapters is not None \
+                and not isinstance(adapters, _adapters.AdapterPool):
+            raise MXNetError(
+                f"adapters must be an AdapterPool, True (build from "
+                f"MXNET_ADAPTER_* env), or None; got {adapters!r}")
+        self._adapter_pool = adapters
+        if self._adapter_pool is not None:
+            if self._mesh is not None:
+                raise MXNetError(
+                    "paged LoRA adapters on a tp/pp-meshed engine are "
+                    "not supported yet — the adapter slabs would need "
+                    "the rules-table sharding the base weights get")
+            pl = self._adapter_pool
+            if pl.num_layers != self._L or pl.d_model != int(d_model) \
+                    or pl.d_out != 3 * int(d_model):
+                raise MXNetError(
+                    f"AdapterPool geometry (layers={pl.num_layers}, "
+                    f"d_model={pl.d_model}, d_out={pl.d_out}) does not "
+                    f"match the engine (layers={self._L}, d_model="
+                    f"{int(d_model)}, d_out={3 * int(d_model)})")
+        self._lora = tuple(self._adapter_pool.rank_buckets) \
+            if self._adapter_pool is not None else None
+        if tenant_quota is None:
+            tenant_quota = _adapters.quota_from_env()
+        self._quota = tenant_quota
+        # per-tenant fairness ledger (requests/tokens/shed), kept at
+        # the same sites as the global counters
+        self._tenants: Dict[str, Dict[str, float]] = {}
+        # draft-LM proposers know their vocab; a draft that tokenizes
+        # differently from the target would propose out-of-range ids
+        if self._proposer is not None \
+                and hasattr(self._proposer, "vocab_size") \
+                and int(self._proposer.vocab_size) != int(vocab_size):
+            raise MXNetError(
+                f"draft_lm proposer vocab {self._proposer.vocab_size} "
+                f"!= target vocab {int(vocab_size)} — draft and "
+                f"target must share a tokenizer")
+
         # -- graphs + pools ---------------------------------------------
         kw = dict(vocab_size=vocab_size, num_layers=num_layers,
                   num_heads=num_heads, d_model=d_model, d_ff=d_ff,
                   kv_block=self._kv_block, paged=True,
-                  kv_dtype=self._kv_dtype)
+                  kv_dtype=self._kv_dtype, lora=self._lora)
         dec_sym = transformer_lm_decode(**kw)
         pre_sym = transformer_lm_prefill(**kw)
         self._dec_gfn = build_graph_fn(dec_sym)
@@ -1306,6 +1372,11 @@ class DecodeEngine:
         if self._quant:
             feed |= {f"layer{i}_{t}scale" for i in range(self._L)
                      for t in "kv"}
+        if self._lora:
+            # adapter slabs + slot vectors are RUNTIME args (like the
+            # pools), never baked params — publish stays drain-free
+            feed |= {f"adapter_{t}_r{rb}" for rb in self._lora
+                     for t in ("a", "b", "slots")}
         self._param_names = [n for n in dec_sym.list_arguments()
                              if n not in feed]
         missing = [n for n in self._param_names if n not in host_params]
@@ -1416,7 +1487,8 @@ class DecodeEngine:
     def submit(self, prompt, max_new_tokens=32, temperature=None,
                eos_id=None, seed=None, trace=None,
                slo_class="interactive", canary=False,
-               prefill_only=False) -> Future:
+               prefill_only=False, tenant=None,
+               adapter=None) -> Future:
         """Enqueue one generation; the Future resolves to the np.int32
         array of generated token ids (eos, when hit, is included).
 
@@ -1475,26 +1547,59 @@ class DecodeEngine:
             raise MXNetError(
                 "prefill_only export from a tp/pp-meshed engine is "
                 "not supported yet (page slabs are per-shard)")
+        # -- tenancy: quota admission + adapter reference ----------------
+        # (typed, per-tenant, BEFORE the stream takes any engine state)
+        if adapter is not None and self._adapter_pool is None:
+            raise MXNetError(
+                f"request names adapter {adapter!r} but the engine "
+                f"has no adapter pool (MXNET_ADAPTER_ENABLE=1 or "
+                f"adapters=AdapterPool(...))")
+        tenant = str(tenant) if tenant is not None else None
+        if self._quota is not None and tenant is not None \
+                and not canary:
+            try:
+                self._quota.charge(tenant, prompt.size + max_new)
+            except QuotaExceededError:
+                self._count("shed")
+                self._count("shed_tenant_quota")
+                self._tenant_count(tenant, "shed")
+                raise
+        ad_bucket = ad_slot = None
+        if adapter is not None:
+            ad_bucket, ad_slot = self._adapter_pool.acquire(adapter)
         temp = self._temperature if temperature is None \
             else float(temperature)
         eos = self._eos if eos_id is None else eos_id
         fut: Future = Future()
-        with self._cond:
-            if not self._accepting:
-                raise EngineClosedError(
-                    self._reject or "DecodeEngine is closed")
-            s = _Stream(self._next_sid, prompt, max_new, temp, eos, fut,
-                        seed=(self._next_sid + 1 if seed is None
-                              else int(seed)), trace=trace,
-                        slo_class=slo_class, canary=canary)
-            s.migrate = bool(prefill_only)
-            self._next_sid += 1
-            self._pending.append(s)
-            self._owned.add(fut)
-            self._cond.notify_all()
+        try:
+            with self._cond:
+                if not self._accepting:
+                    raise EngineClosedError(
+                        self._reject or "DecodeEngine is closed")
+                s = _Stream(self._next_sid, prompt, max_new, temp, eos,
+                            fut,
+                            seed=(self._next_sid + 1 if seed is None
+                                  else int(seed)), trace=trace,
+                            slo_class=slo_class, canary=canary,
+                            tenant=tenant, adapter=adapter)
+                s.adapter_bucket, s.adapter_slot = ad_bucket, ad_slot
+                s.migrate = bool(prefill_only)
+                self._next_sid += 1
+                self._pending.append(s)
+                self._owned.add(fut)
+                self._cond.notify_all()
+        except BaseException:
+            if adapter is not None:  # refused: hand the ref back
+                self._adapter_pool.release(adapter)
+            if self._quota is not None and tenant is not None \
+                    and not canary:
+                self._quota.refund(tenant, prompt.size + max_new)
+            raise
         fut.add_done_callback(self._disown)
         if not canary:  # probes keep request counters honest
             self._count("requests")
+            if tenant is not None:
+                self._tenant_count(tenant, "requests")
         return fut
 
     def _disown(self, fut):
@@ -1582,6 +1687,54 @@ class DecodeEngine:
             return self._mesh.unshard_params(self._params)
         return {n: np.asarray(v) for n, v in self._params.items()}
 
+    def publish_adapter(self, name, a, b, alpha=None) -> int:
+        """Install a LoRA adapter under ``name`` — HOT.  The slabs are
+        runtime executable arguments (like the base weights), so the
+        publish is a functional slab update plus one atomic reference
+        swap inside the pool: no drain, no recompile, and in-flight
+        streams keep reading the rows their slot ids pin (eviction
+        only ever touches refcount-0 slots).  Returns the slot."""
+        if self._adapter_pool is None:
+            raise MXNetError(
+                "publish_adapter: this engine has no adapter pool "
+                "(construct with adapters=..., or set "
+                "MXNET_ADAPTER_ENABLE=1)")
+        slot = self._adapter_pool.publish(name, a, b, alpha=alpha)
+        # a retire-then-republish binds NEW weights to the name: prefix
+        # chains prefilled under the old ones (the name is the cache
+        # salt) must stop being matchable.  Queued: only the scheduler
+        # thread may touch the radix tree (it attaches unlocked).
+        self._queue_prefix_invalidate(name)
+        self._count("adapter_publishes")
+        return slot
+
+    def retire_adapter(self, name) -> bool:
+        """Retire an adapter by name — also hot.  If streams still
+        hold references the retire is DEFERRED: the name stops being
+        acquirable immediately, and the slot frees when the last
+        holder retires.  Returns True if the slot freed now."""
+        if self._adapter_pool is None:
+            raise MXNetError(
+                "retire_adapter: this engine has no adapter pool")
+        freed = self._adapter_pool.retire(name)
+        # reclaim the retiring adapter's parked prefix chains (nothing
+        # can match them again: acquire-by-name is gone)
+        self._queue_prefix_invalidate(name)
+        self._count("adapter_retires")
+        return freed
+
+    def _queue_prefix_invalidate(self, name) -> None:
+        """Queue an adapter-salt prefix invalidation for the scheduler
+        thread (which owns the radix tree).  Applied at the next
+        admission pass — before any request submitted after this call
+        can be admitted, so a post-(re)publish stream never matches a
+        chain prefilled under the name's old weights."""
+        if self._prefix is None:
+            return
+        with self._cond:
+            self._prefix_dirty.append(str(name).encode("utf-8"))
+            self._cond.notify_all()
+
     def generate(self, prompt, max_new_tokens=32, **kw) -> np.ndarray:
         """Synchronous convenience: ``submit(...).result()``."""
         return self.submit(prompt, max_new_tokens, **kw).result()
@@ -1613,6 +1766,16 @@ class DecodeEngine:
         self._metrics.inc(name, value)
         profiler.inc_counter(f"serving.{name}", value)
 
+    def _tenant_count(self, tenant, name, value=1):
+        """Per-tenant fairness counters (requests/tokens/shed) — same
+        increment sites as the engine-global counters so the sums
+        reconcile."""
+        if tenant is None:
+            return
+        with self._lock:
+            d = self._tenants.setdefault(tenant, {})
+            d[name] = d.get(name, 0) + value
+
     # ------------------------------------------------------------------
     def reset_stats(self):
         """Zero the engine-local counters/histograms so the next
@@ -1620,6 +1783,8 @@ class DecodeEngine:
         isolate sweep points; lifetime percentiles blend loads)."""
         self._metrics.reset()
         self._cost_agg.reset()
+        with self._lock:
+            self._tenants.clear()
         if self._prefix is not None:
             self._prefix.reset_counters()
 
@@ -1707,6 +1872,21 @@ class DecodeEngine:
         out["migrations_per_s"] = round(
             summ["rates"].get("migrations_out", 0.0)
             + summ["rates"].get("migrations_in", 0.0), 4)
+        # multi-tenancy: fairness counters per tenant (requests /
+        # tokens / shed at the same sites as the globals), quota
+        # balances, and retired-stream cost attribution by tenant
+        out["shed"] = int(c.get("shed", 0))
+        out["shed_tenant_quota"] = int(c.get("shed_tenant_quota", 0))
+        with self._lock:
+            out["tenants"] = {t: dict(d)
+                              for t, d in self._tenants.items()}
+        if self._quota is not None:
+            for t, q in self._quota.stats().items():
+                out["tenants"].setdefault(t, {}).update(q)
+        out["cost_by_tenant"] = self._cost_agg.by_tenant()
+        if self._adapter_pool is not None:
+            out["adapters"] = self._adapter_pool.stats()
+            out["adapter_rank_buckets"] = list(self._lora or ())
         return out
 
     def cost_records(self) -> List[dict]:
@@ -1778,6 +1958,7 @@ class DecodeEngine:
             if s.blocks:
                 self._release_pages(s.blocks)
                 s.blocks = []
+            self._release_adapter(s)
             if s.future.set_running_or_notify_cancel():
                 s.future.set_exception(exc)
 
@@ -1833,11 +2014,12 @@ class DecodeEngine:
             gkey = self._graph_key
 
             def step(params, tokens, positions, lengths, table, temps,
-                     seeds, steps, pools):
+                     seeds, steps, pools, *adapter):
                 args = dict(params)
                 args.update(data=tokens, positions=positions,
                             lengths=lengths, block_table=table)
                 self._pool_args(args, pools)
+                self._adapter_bind(args, adapter)
                 outs, _ = gfn(args, {}, gkey, False)
                 toks = self._sample(outs[0][:, 0, :], temps, seeds,
                                     steps)
@@ -1855,7 +2037,8 @@ class DecodeEngine:
                      self._arg_spec((bb,), np.dtype(np.float32)),
                      self._arg_spec((bb,), i32),
                      self._arg_spec((bb,), i32),
-                     self._spec_of(self._pools))
+                     self._spec_of(self._pools)) \
+                + self._adapter_specs(bb)
             with profiler.scope(f"serving.compile.decode.b{bb}x{mb}",
                                 "serving", args={"batch": bb,
                                                  "blocks": mb}):
@@ -1892,12 +2075,13 @@ class DecodeEngine:
             base = self._base_key
 
             def step(params, tokens, positions, start, lengths, table,
-                     temps, seeds, steps0, pools):
+                     temps, seeds, steps0, pools, *adapter):
                 args = dict(params)
                 args.update(data=tokens, positions=positions,
                             start=start, lengths=lengths,
                             block_table=table)
                 self._pool_args(args, pools)
+                self._adapter_bind(args, adapter)
                 outs, _ = gfn(args, {}, gkey, False)
                 emit = verify_sample(base, outs[0], tokens,
                                      lengths - start, temps, seeds,
@@ -1917,7 +2101,8 @@ class DecodeEngine:
                      self._arg_spec((bb,), np.dtype(np.float32)),
                      self._arg_spec((bb,), i32),
                      self._arg_spec((bb,), i32),
-                     self._spec_of(self._pools))
+                     self._spec_of(self._pools)) \
+                + self._adapter_specs(bb)
             with profiler.scope(
                     f"serving.compile.verify.b{bb}x{mb}w{W}",
                     "serving", args={"batch": bb, "blocks": mb,
@@ -1948,11 +2133,12 @@ class DecodeEngine:
             mb = tp // self._kv_block
 
             def prefill(params, tokens, positions, lengths, table,
-                        temps, seeds, steps, pools):
+                        temps, seeds, steps, pools, *adapter):
                 args = dict(params)
                 args.update(data=tokens, positions=positions,
                             lengths=lengths, block_table=table)
                 self._pool_args(args, pools)
+                self._adapter_bind(args, adapter)
                 outs, _ = gfn(args, {}, gkey, False)
                 logits = outs[0]          # (1, Tp, V)
                 last = logits[jnp.arange(logits.shape[0]),
@@ -1972,7 +2158,8 @@ class DecodeEngine:
                      self._arg_spec((1,), np.dtype(np.float32)),
                      self._arg_spec((1,), i32),
                      self._arg_spec((1,), i32),
-                     self._spec_of(self._pools))
+                     self._spec_of(self._pools)) \
+                + self._adapter_specs(1)
             with profiler.scope(f"serving.compile.prefill.t{tp}",
                                 "serving", args={"tokens": tp}):
                 jitted = jax.jit(
@@ -1996,6 +2183,55 @@ class DecodeEngine:
                 args[f"layer{i}_vscale"] = pools[st * i + 3]
         return args
 
+    def _adapter_bind(self, args, adapter):
+        """Bind the flat adapter runtime args — per rank bucket a
+        (a_slab, b_slab, slot_vector) triple, in rank_buckets order.
+        A no-adapter engine passes () and binds nothing."""
+        if not self._lora:
+            return args
+        for j, rb in enumerate(self._lora):
+            args[f"adapter_a_r{rb}"] = adapter[3 * j]
+            args[f"adapter_b_r{rb}"] = adapter[3 * j + 1]
+            args[f"adapter_slots_r{rb}"] = adapter[3 * j + 2]
+        return args
+
+    def _adapter_specs(self, bb: int) -> tuple:
+        """AOT input specs for the adapter args at batch bucket
+        ``bb`` — slab shapes are fixed by the pool, so the executable
+        matrix gains NO new dimension from multi-tenancy."""
+        if not self._lora:
+            return ()
+        i32 = np.dtype(np.int32)
+        specs = []
+        slabs = self._adapter_pool.slabs()
+        for j, rb in enumerate(self._lora):
+            specs.append(self._spec_of(slabs[2 * j]))
+            specs.append(self._spec_of(slabs[2 * j + 1]))
+            specs.append(self._arg_spec((bb,), i32))
+        return tuple(specs)
+
+    def _adapter_args(self, streams, bb: int) -> tuple:
+        """Call-time adapter args for one step: the pool's CURRENT
+        slabs (fetched once — an atomic snapshot, so a concurrent
+        publish lands next step, never mid-step) plus per-bucket slot
+        vectors gathered from the batch.  Rows without an adapter —
+        pad rows included — carry slot 0, the exact no-op."""
+        if not self._lora:
+            return ()
+        import jax
+
+        slabs = self._adapter_pool.slabs()
+        out = []
+        for j, rb in enumerate(self._lora):
+            vec = np.zeros(bb, np.int32)
+            for i, s in enumerate(streams):
+                if s is not None and s.adapter_slot is not None \
+                        and s.adapter_bucket == rb:
+                    vec[i] = s.adapter_slot
+            out.extend((slabs[2 * j], slabs[2 * j + 1],
+                        jax.device_put(vec, self._device)))
+        return tuple(out)
+
     def _prefix_prefill_exe(self, tp: int, mb: int):
         """Suffix-prefill executable for a prefix-cache hit: suffix
         padded to ``tp`` tokens, block table padded to ``mb`` pages
@@ -2015,12 +2251,13 @@ class DecodeEngine:
             gkey = self._graph_key
 
             def prefill(params, tokens, positions, start, lengths,
-                        table, temps, seeds, steps, pools):
+                        table, temps, seeds, steps, pools, *adapter):
                 args = dict(params)
                 args.update(data=tokens, positions=positions,
                             start=start, lengths=lengths,
                             block_table=table)
                 self._pool_args(args, pools)
+                self._adapter_bind(args, adapter)
                 outs, _ = gfn(args, {}, gkey, False)
                 logits = outs[0]          # (1, Ts, V) — SUFFIX rows
                 last = logits[jnp.arange(logits.shape[0]),
@@ -2041,7 +2278,8 @@ class DecodeEngine:
                      self._arg_spec((1,), np.dtype(np.float32)),
                      self._arg_spec((1,), i32),
                      self._arg_spec((1,), i32),
-                     self._spec_of(self._pools))
+                     self._spec_of(self._pools)) \
+                + self._adapter_specs(1)
             with profiler.scope(
                     f"serving.compile.prefix_prefill.t{tp}x{mb}",
                     "serving", args={"tokens": tp, "blocks": mb}):
@@ -2166,16 +2404,34 @@ class DecodeEngine:
         prompt token (whose page write COWs at the first step).
         Matched-but-parked pages are about to be revived, so they do
         NOT count as spare capacity for the admission check."""
+        if self._prefix is not None and self._prefix_dirty:
+            # adapter (re)publish/retire queued salt invalidations:
+            # apply them HERE, on the tree-owning thread, before any
+            # post-publish request can match a stale chain
+            with self._cond:
+                dirty, self._prefix_dirty = self._prefix_dirty, []
+            for salt in dirty:
+                self._prefix.invalidate_salt(salt)
         while True:
             with self._lock:
                 if not self._pending \
                         or len(self._active) >= self._max_streams \
                         or self._prefilling is not None:
                     return
-                s = self._pending[0]
+                # SLO-tiered admission: the first interactive stream
+                # jumps the batch queue (within a class, FIFO order
+                # holds — preempted re-queues sit at the front and
+                # are interactive-or-original-class anyway)
+                pick = 0
+                for i, cand in enumerate(self._pending):
+                    if cand.slo_class == "interactive":
+                        pick = i
+                        break
+                s = self._pending[pick]
                 seq = s.prefill_seq()
                 if self._prefix is not None:
-                    cached, parked_matched = self._prefix.peek(seq)
+                    cached, parked_matched = self._prefix.peek(
+                        seq, salt=_prefix_salt(s))
                 else:
                     cached, parked_matched = 0, 0
                 # cached is block-aligned, so the suffix page count is
@@ -2204,13 +2460,14 @@ class DecodeEngine:
                 avail = self._alloc.free_blocks - parked_matched
                 if avail < min(need + 1, max(lifetime_new, 1)):
                     return  # not enough cache: hold the FIFO line
-                self._pending.pop(0)
+                self._pending.pop(pick)
                 self._admitting = s  # visible to _fail_outstanding
             # On failure _admitting must STAY set until the loop's
             # poison handler runs — clearing it first would strand the
             # caller's future between pop and activation.
             if self._prefix is not None:
-                cached, pages = self._prefix.attach(seq, owner=s.sid)
+                cached, pages = self._prefix.attach(
+                    seq, owner=s.sid, salt=_prefix_salt(s))
             else:
                 cached, pages = 0, []
             s.cost.book_pages(0)  # page-second clock starts at attach
@@ -2314,7 +2571,8 @@ class DecodeEngine:
                 stage_array(positions, dev), stage_array(start, dev),
                 stage_array(lengths, dev), stage_array(table, dev),
                 stage_array(temps, dev), stage_array(seeds, dev),
-                stage_array(steps, dev), self._pools)
+                stage_array(steps, dev), self._pools,
+                *self._adapter_args([s], 1))
         s.cost.flops_est += self._exe_flops.get(
             ("prefix_prefill", tp, mb), 0.0)
         return toks, tp
@@ -2358,7 +2616,7 @@ class DecodeEngine:
                     stage_array(lengths, dev),
                     stage_array(table, dev), stage_array(temps, dev),
                     stage_array(seeds, dev), stage_array(steps, dev),
-                    self._pools)
+                    self._pools, *self._adapter_args([s], 1))
                 first = int(np.asarray(toks)[0])
             s.cost.flops_est += self._exe_flops.get(("prefill", tp),
                                                     0.0)
@@ -2381,7 +2639,8 @@ class DecodeEngine:
             # indexed keep the incumbent page (ours stays private) — a
             # migrating stream's pages are about to LEAVE this pool,
             # so they never enter the index
-            self._prefix.register(s.prompt, s.blocks)
+            self._prefix.register(s.prompt, s.blocks,
+                                  salt=_prefix_salt(s))
         prefill_ms = (t_done - t_pre0) * 1e3
         self._metrics.observe("prefill_ms", prefill_ms)
         profiler.observe("serving.prefill_ms", prefill_ms)
@@ -2535,8 +2794,12 @@ class DecodeEngine:
             # path reclaims it (liveness preserved).
             productive = [v for v in victims
                           if self._reclaimable(v) > 0]
+            # SLO tiering extends the pressure ladder: among equally
+            # productive victims, a batch-class stream is preempted
+            # before any interactive one, youngest first within a tier
             victim = max(productive or victims,
-                         key=lambda v: v.t_admit)
+                         key=lambda v: (v.slo_class == "batch",
+                                        v.t_admit))
             self._preempt(victim)
 
     def _ensure_capacity(self, s: _Stream, ahead: int = 1) -> bool:
@@ -2608,11 +2871,30 @@ class DecodeEngine:
             self._pending.insert(0, victim)
         self._count("preempted")
 
+    def _release_adapter(self, s: _Stream):
+        """Drop the stream's adapter-pool reference exactly once (the
+        slot id in the stream doubles as the not-yet-released flag).
+        Preemption does NOT come through here — a preempted stream
+        keeps its reference so the slot cannot be evicted while it
+        waits for re-admission."""
+        if s.adapter is None or s.adapter_slot is None:
+            return
+        s.adapter_slot = None
+        try:
+            self._adapter_pool.release(s.adapter)
+        except MXNetError:
+            pass  # pool already torn down (close during shutdown)
+
     def _retire(self, s: _Stream):
         s.cost.book_pages(len(s.blocks))
         if s.blocks:
             self._release_pages(s.blocks)
             s.blocks = []
+        self._release_adapter(s)
+        if s.tenant is not None and not s.canary:
+            self._tenant_count(s.tenant, "tokens",
+                               len(s.generated) + len(s.prompt))
+            self._tenant_count(s.tenant, "generations")
         if s.future.set_running_or_notify_cancel():
             s.future.set_result(np.asarray(s.generated, np.int32))
         self._count("generations")
@@ -2660,6 +2942,8 @@ class DecodeEngine:
             "await_first": bool(s.await_first),
             "slo_class": s.slo_class,
             "canary": bool(s.canary),
+            "tenant": s.tenant,
+            "adapter": s.adapter,
             "done": done,
             "n_pages": 0 if done else len(s.blocks),
             "kv_dtype": self._kv_dtype,
@@ -2682,6 +2966,8 @@ class DecodeEngine:
             else:
                 self._alloc.export_pages([p])
         s.blocks = []
+        # the decode replica re-acquires the adapter by name on import
+        self._release_adapter(s)
         t_done = time.perf_counter()
         ms = (t_done - t0) * 1e3
         # the migration counter and the cost-record mirror increment
@@ -2791,7 +3077,8 @@ class DecodeEngine:
             productive = [v for v in victims
                           if self._reclaimable(v) > 0]
             victim = max(productive or victims,
-                         key=lambda v: v.t_admit)
+                         key=lambda v: (v.slo_class == "batch",
+                                        v.t_admit))
             self._preempt(victim)
 
     def _absorb_imports(self):
@@ -2821,6 +3108,27 @@ class DecodeEngine:
                     pools[i] = pools[i].at[idx].set(slab)
                 self._pools = tuple(pools)
             prompt = np.asarray(arrays[0], np.int32)
+            tenant = meta.get("tenant")
+            adapter = meta.get("adapter")
+            if adapter is not None:
+                # the importer re-acquires the adapter BY NAME — both
+                # roles must have published it (fleet broadcast does)
+                if self._adapter_pool is None:
+                    if fut.set_running_or_notify_cancel():
+                        fut.set_exception(MXNetError(
+                            f"migrated stream uses adapter "
+                            f"{adapter!r} but this engine has no "
+                            f"adapter pool"))
+                    self._release_pages(pages)
+                    continue
+                try:
+                    ad_bucket, ad_slot = \
+                        self._adapter_pool.acquire(adapter)
+                except MXNetError as e:
+                    if fut.set_running_or_notify_cancel():
+                        fut.set_exception(e)
+                    self._release_pages(pages)
+                    continue
             s = _Stream(sid, prompt, int(meta["max_new"]),
                         float(meta["temp"]),
                         None if meta["eos"] is None
@@ -2828,7 +3136,10 @@ class DecodeEngine:
                         fut, seed=int(meta["seed"]), trace=trace,
                         slo_class=meta.get("slo_class",
                                            "interactive"),
-                        canary=bool(meta.get("canary", False)))
+                        canary=bool(meta.get("canary", False)),
+                        tenant=tenant, adapter=adapter)
+            if adapter is not None:
+                s.adapter_bucket, s.adapter_slot = ad_bucket, ad_slot
             s.generated = [int(t) for t in np.asarray(arrays[1])]
             s.blocks = pages
             s.length = int(meta["length"])
@@ -2953,7 +3264,8 @@ class DecodeEngine:
                 stage_array(positions, dev), stage_array(start, dev),
                 stage_array(lengths, dev), stage_array(table, dev),
                 stage_array(temps, dev), stage_array(seeds, dev),
-                stage_array(steps0, dev), self._pools)
+                stage_array(steps0, dev), self._pools,
+                *self._adapter_args(streams, bb))
             emit = np.asarray(emit)  # ONE (B, W) D2H for k+1 tokens
         self._count("d2h_syncs")
         t_done = time.perf_counter()
@@ -3079,6 +3391,10 @@ class DecodeEngine:
         exe = self._decode_exe(bb, mb)
         # the batch program's FLOPs, split evenly across the riders
         fl = self._exe_flops.get(("decode", bb, mb), 0.0) / n
+        # one adapter snapshot serves both halves of a pipelined pair
+        # (the batch composition is pinned, so the slot vectors are
+        # identical; a concurrent publish lands at the next pair)
+        adapter = self._adapter_args(streams, bb)
         tokens = np.zeros((bb, 1), np.int32)
         positions = np.zeros((bb, 1), np.int32)
         lengths = np.zeros((bb,), np.int32)
@@ -3105,7 +3421,7 @@ class DecodeEngine:
                 stage_array(positions, dev), stage_array(lengths, dev),
                 stage_array(table, dev), stage_array(temps, dev),
                 stage_array(seeds, dev), stage_array(steps, dev),
-                self._pools)
+                self._pools, *adapter)
         if not pipeline:
             toks = np.asarray(toks_dev)
             self._count("d2h_syncs")
@@ -3128,7 +3444,7 @@ class DecodeEngine:
                 stage_array(positions2, dev),
                 stage_array(lengths2, dev), stage_array(table, dev),
                 stage_array(temps, dev), stage_array(seeds, dev),
-                stage_array(steps2, dev), self._pools)
+                stage_array(steps2, dev), self._pools, *adapter)
         toks = np.asarray(toks_dev)  # overlaps step t+1's compute
         self._count("d2h_syncs")
         self._count("d2h_syncs_saved")
@@ -3246,13 +3562,17 @@ class ReplicaHarness:
         return self.engine.submit(inputs, trace=trace)
 
     def submit_decode(self, prompt, max_new_tokens=32, temperature=None,
-                      eos_id=None, seed=None, trace=None) -> Future:
+                      eos_id=None, seed=None, trace=None,
+                      slo_class="interactive", tenant=None,
+                      adapter=None) -> Future:
         if self.kind != "decode":
             raise MXNetError("replica serves inference requests; "
                              "a decode request cannot ride it")
         return self.engine.submit(prompt, max_new_tokens,
                                   temperature=temperature, eos_id=eos_id,
-                                  seed=seed, trace=trace)
+                                  seed=seed, trace=trace,
+                                  slo_class=slo_class, tenant=tenant,
+                                  adapter=adapter)
 
     # -- disaggregated prefill/decode -----------------------------------
     def set_role(self, role: str):
@@ -3274,7 +3594,8 @@ class ReplicaHarness:
 
     def submit_prefill_export(self, prompt, max_new_tokens=32,
                               temperature=None, eos_id=None, seed=None,
-                              trace=None) -> Future:
+                              trace=None, slo_class="interactive",
+                              tenant=None, adapter=None) -> Future:
         """Disagg phase 1: admission + prefill + first token, then the
         KV pages leave the pool as a migration payload (the Future's
         result — see :meth:`DecodeEngine.submit` ``prefill_only``)."""
@@ -3288,7 +3609,8 @@ class ReplicaHarness:
         return self.engine.submit(prompt, max_new_tokens,
                                   temperature=temperature, eos_id=eos_id,
                                   seed=seed, trace=trace,
-                                  prefill_only=True)
+                                  slo_class=slo_class, tenant=tenant,
+                                  adapter=adapter, prefill_only=True)
 
     def submit_import(self, meta: dict, arrays, trace=None) -> Future:
         """Disagg phase 2: splice a migrated stream's KV pages into
@@ -3302,6 +3624,23 @@ class ReplicaHarness:
                 "replica role is 'prefill' — migrated streams must "
                 "land on a decode-role replica")
         return self.engine.import_stream(meta, arrays, trace=trace)
+
+    # -- multi-tenant adapters -------------------------------------------
+    def publish_adapter(self, name, a, b, alpha=None) -> int:
+        """Hot LoRA publish (no drain) — see
+        :meth:`DecodeEngine.publish_adapter`."""
+        if self.kind != "decode":
+            raise MXNetError(
+                "adapters ride the decode engine; an InferenceEngine "
+                "replica has no adapter pool")
+        return self.engine.publish_adapter(name, a, b, alpha=alpha)
+
+    def retire_adapter(self, name) -> bool:
+        if self.kind != "decode":
+            raise MXNetError(
+                "adapters ride the decode engine; an InferenceEngine "
+                "replica has no adapter pool")
+        return self.engine.retire_adapter(name)
 
     # -- router-facing state --------------------------------------------
     def inflight(self) -> int:

@@ -595,13 +595,20 @@ class CostRecord:
     counters (prefill completion, step absorption, COW probe), so the
     conservation property is structural, not statistical."""
 
-    __slots__ = ("sid", "slo_class", "canary", "t_submit",
-                 "t_retired", "pg_t") + COST_FIELDS
+    __slots__ = ("sid", "slo_class", "canary", "tenant", "adapter_id",
+                 "t_submit", "t_retired", "pg_t") + COST_FIELDS
 
-    def __init__(self, sid: int, slo_class: str, canary: bool):
+    def __init__(self, sid: int, slo_class: str, canary: bool,
+                 tenant: Optional[str] = None,
+                 adapter_id: Optional[str] = None):
         self.sid = sid
         self.slo_class = slo_class
         self.canary = canary
+        # tenancy identity (PR 20): stamped at submit, mirrored into
+        # every retired record at the SAME sites as the class fields,
+        # so per-tenant sums conserve exactly like per-class sums do
+        self.tenant = tenant
+        self.adapter_id = adapter_id
         self.t_submit = time.perf_counter()
         self.t_retired = 0.0
         self.pg_t = self.t_submit  # last page-count booking time
@@ -623,7 +630,8 @@ class CostRecord:
         d["page_s"] = round(d["page_s"], 6)
         d["migration_ms"] = round(d["migration_ms"], 6)
         d.update(sid=self.sid, slo_class=self.slo_class,
-                 canary=self.canary,
+                 canary=self.canary, tenant=self.tenant,
+                 adapter_id=self.adapter_id,
                  wall_s=round(self.t_retired - self.t_submit, 6))
         return d
 
@@ -637,6 +645,7 @@ class CostAggregator:
     def __init__(self, keep: int = 1024):
         self._lock = threading.Lock()
         self._by_class: Dict[str, Dict[str, float]] = {}
+        self._by_tenant: Dict[str, Dict[str, float]] = {}
         self.records: Deque[dict] = collections.deque(maxlen=keep)
 
     def add(self, rec: CostRecord):
@@ -650,6 +659,14 @@ class CostAggregator:
             for f in COST_FIELDS:
                 agg[f] += d[f]
             agg["requests"] = agg.get("requests", 0) + 1
+            if rec.tenant is not None:
+                # same increment site as the class sums: per-tenant
+                # conservation is structural too
+                tag = self._by_tenant.setdefault(
+                    rec.tenant, {f: 0.0 for f in COST_FIELDS})
+                for f in COST_FIELDS:
+                    tag[f] += d[f]
+                tag["requests"] = tag.get("requests", 0) + 1
             self.records.append(d)
         for f in ("tokens", "prefill_tokens", "flops_est", "page_s"):
             if d[f]:
@@ -662,9 +679,18 @@ class CostAggregator:
                         for k, v in agg.items()}
                     for c, agg in self._by_class.items()}
 
+    def by_tenant(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant sums of retired records (only streams submitted
+        with a tenant appear; same fields as :meth:`by_class`)."""
+        with self._lock:
+            return {t: {k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in agg.items()}
+                    for t, agg in self._by_tenant.items()}
+
     def reset(self):
         with self._lock:
             self._by_class.clear()
+            self._by_tenant.clear()
             self.records.clear()
 
 

@@ -51,10 +51,10 @@ import numpy as np
 
 from .base import MXNetError
 
-__all__ = ["NgramProposer", "make_proposer", "verify_sample",
-           "PROPOSERS"]
+__all__ = ["NgramProposer", "DraftLMProposer", "make_proposer",
+           "draft_lm_from_env", "verify_sample", "PROPOSERS"]
 
-PROPOSERS = ("ngram",)
+PROPOSERS = ("ngram", "draft_lm")
 
 
 class NgramProposer:
@@ -105,11 +105,139 @@ class NgramProposer:
         return np.empty(0, np.int32)
 
 
+class DraftLMProposer:
+    """A small trained LM drafting for the big one (Leviathan-style
+    two-model speculation) behind the same ``propose(context, k)``
+    interface as the self-drafters.
+
+    Drafting is GREEDY and therefore a deterministic function of the
+    context — the fleet's decode-retry bit-replay contract holds
+    exactly as it does for the n-gram proposer; the verify sampler
+    keeps the TARGET distribution exact regardless of how the drafts
+    were produced (greedy target decode stays bit-identical,
+    temperature stays exactly the target distribution).
+
+    The draft runs its own full causal forward per proposed token
+    through ONE fixed-shape executable (context padded to the draft's
+    ``max_len`` window, answer read at row ``t-1`` — causality makes
+    the padded tail invisible), so the host cost is k small forwards
+    per scheduling step and there is no second KV cache to manage,
+    migrate, or keep weight-synced.  Architecture is inferred from
+    the parameter shapes; ``num_heads`` is not recoverable from a
+    fused-QKV checkpoint and must be given
+    (``MXNET_SERVING_DRAFT_HEADS``)."""
+
+    def __init__(self, params: Dict, *, num_heads: int,
+                 kv_block: int = 16):
+        import jax
+        import jax.numpy as jnp
+
+        from .executor import build_graph_fn
+        from .models.transformer import transformer_lm_prefill
+
+        host = {k: (np.asarray(v.asnumpy()) if hasattr(v, "asnumpy")
+                    else np.asarray(v)) for k, v in params.items()}
+        for need in ("tok_embed_weight", "pos_embed_weight",
+                     "layer0_qkv_weight", "layer0_ff1_weight"):
+            if need not in host:
+                raise MXNetError(
+                    f"draft_lm checkpoint is missing {need!r} — "
+                    f"MXNET_SERVING_DRAFT_CKPT must point at a "
+                    f"transformer_lm checkpoint (have: "
+                    f"{sorted(host)[:8]}...)")
+        self.vocab_size, d_model = host["tok_embed_weight"].shape
+        self.max_len = int(host["pos_embed_weight"].shape[0])
+        self.d_model = int(d_model)
+        layers = [int(k[len("layer"):-len("_qkv_weight")])
+                  for k in host if k.startswith("layer")
+                  and k.endswith("_qkv_weight")]
+        self.num_layers = max(layers) + 1
+        d_ff = int(host["layer0_ff1_weight"].shape[0])
+        self.num_heads = int(num_heads)
+        if self.num_heads < 1 or self.d_model % self.num_heads:
+            raise MXNetError(
+                f"MXNET_SERVING_DRAFT_HEADS={num_heads} must be >= 1 "
+                f"and divide the draft d_model {self.d_model}")
+        sym = transformer_lm_prefill(
+            self.vocab_size, num_layers=self.num_layers,
+            num_heads=self.num_heads, d_model=self.d_model, d_ff=d_ff,
+            kv_block=kv_block, paged=False)
+        self._gfn = build_graph_fn(sym)
+        self._args = {n: jnp.asarray(host[n])
+                      for n in sym.list_arguments() if n in host}
+        missing = [n for n in sym.list_arguments()
+                   if n not in host and n not in ("data", "positions",
+                                                  "lengths")]
+        if missing:
+            raise MXNetError(
+                f"draft_lm checkpoint is missing parameters {missing}")
+        self._pos = jnp.asarray(
+            np.arange(self.max_len, dtype=np.int32)[None])
+        self._key = jax.random.PRNGKey(0)
+
+    def propose(self, context: np.ndarray, k: int) -> np.ndarray:
+        import jax.numpy as jnp
+
+        ctx = np.asarray(context, np.int32)
+        if k < 1 or ctx.size == 0:
+            return np.empty(0, np.int32)
+        k = min(int(k), self.max_len - 1)
+        # the draft sees at most its own window; keep the TAIL (the
+        # recent tokens carry the signal) and leave room for k drafts
+        keep = max(1, self.max_len - k)
+        seq = [int(t) for t in ctx[-keep:]]
+        out = []
+        for _ in range(k):
+            t = len(seq)
+            buf = np.zeros((1, self.max_len), np.int32)
+            buf[0, :t] = seq
+            args = dict(self._args)
+            args.update(data=jnp.asarray(buf), positions=self._pos,
+                        lengths=jnp.asarray(
+                            np.asarray([t], np.int32)))
+            outs, _ = self._gfn(args, {}, self._key, False)
+            nxt = int(np.argmax(np.asarray(outs[0][0, t - 1])))
+            out.append(nxt)
+            seq.append(nxt)
+        return np.asarray(out, np.int32)
+
+
+def draft_lm_from_env(kv_block: int = 16) -> DraftLMProposer:
+    """Build the draft-LM proposer from ``MXNET_SERVING_DRAFT_CKPT``
+    (newest committed checkpoint under it) and
+    ``MXNET_SERVING_DRAFT_HEADS`` — loud at engine construction."""
+    from .base import get_env
+    from .checkpoint import load_latest_params
+
+    path = get_env("MXNET_SERVING_DRAFT_CKPT", None, str)
+    if not path:
+        raise MXNetError(
+            "MXNET_SERVING_PROPOSER=draft_lm needs "
+            "MXNET_SERVING_DRAFT_CKPT pointing at the draft model's "
+            "checkpoint directory")
+    raw = get_env("MXNET_SERVING_DRAFT_HEADS", None, str)
+    try:
+        heads = int(raw) if raw is not None else 0
+    except ValueError:
+        raise MXNetError(
+            f"MXNET_SERVING_DRAFT_HEADS={raw!r} is not an integer")
+    if heads < 1:
+        raise MXNetError(
+            f"MXNET_SERVING_DRAFT_HEADS={heads} must be >= 1 when "
+            f"MXNET_SERVING_PROPOSER=draft_lm")
+    params, _, _ = load_latest_params(path)
+    return DraftLMProposer(params, num_heads=heads, kv_block=kv_block)
+
+
 def make_proposer(name: str, **kw):
     """Proposer registry (``MXNET_SERVING_PROPOSER``): unknown names
     raise loudly at engine construction."""
     if name == "ngram":
         return NgramProposer(**kw)
+    if name == "draft_lm":
+        if "params" in kw:
+            return DraftLMProposer(**kw)
+        return draft_lm_from_env(**kw)
     raise MXNetError(
         f"unknown speculative proposer {name!r} "
         f"(MXNET_SERVING_PROPOSER wants one of {PROPOSERS})")

@@ -483,6 +483,246 @@ def main_decode():
 
 
 # ---------------------------------------------------------------------------
+# --decode --lora: multi-tenant paged-LoRA serving.
+#
+# Methodology (PERF.md appendix "Multi-tenant serving"):
+# - The single-tenant reference is a pool-LESS engine (no LoRA
+#   epilogue compiled in) under the same closed-loop chat workload —
+#   "what you give up for tenancy" includes the gather epilogue, not
+#   just adapter traffic.
+# - The sweep then runs ONE pool-backed engine at 0/1/4/8 distinct
+#   adapters mixed into the batch (80% adapter traffic, 20% plain;
+#   70/30 interactive/batch SLO mix; tenant == adapter owner).  The
+#   0-adapter point isolates the epilogue overhead on plain traffic.
+# - Adapter slots are fewer than the widest mix (default 4 slots vs
+#   8 adapters) so the LRU pool actually parks/evicts and the hit
+#   rate means something; slots >= clients keeps acquire safe (a
+#   closed loop holds at most `clients` live adapters).
+# - Quota shed is demonstrated on a separate tiny engine with a hard
+#   token budget (refill 0): over-budget submits must shed TYPED
+#   (QuotaExceededError, reason "tenant_quota"), never mid-stream.
+# ---------------------------------------------------------------------------
+
+
+def bench_lora_point(eng, mk_request, clients, per_client):
+    """Closed loop like bench_decode_point, but each request carries
+    (tenant, adapter, slo_class) and quota sheds are caught per
+    client rather than failing the point."""
+    from mxnet_tpu.adapters import QuotaExceededError
+
+    eng.reset_stats()
+    errs, done, sheds = [], [], []
+    lock = threading.Lock()
+    start = threading.Barrier(clients + 1)
+
+    def client(cid):
+        rng = np.random.RandomState(7000 + cid)
+        try:
+            start.wait(timeout=120)
+            for _ in range(per_client):
+                prompt, n_new, kw = mk_request(rng)
+                try:
+                    out = eng.generate(prompt, n_new, **kw)
+                except QuotaExceededError:
+                    with lock:
+                        sheds.append(kw.get("slo_class", "interactive"))
+                    continue
+                with lock:
+                    done.append(len(out))
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=client, args=(c,), daemon=True)
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    st0 = eng.stats()
+    ad0 = st0.get("adapters", {})
+    start.wait(timeout=120)
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errs:
+        raise errs[0]
+    st1 = eng.stats()
+    ad1 = st1.get("adapters", {})
+    hits = ad1.get("hits", 0) - ad0.get("hits", 0)
+    misses = ad1.get("misses", 0) - ad0.get("misses", 0)
+    out = {
+        "clients": clients,
+        "tokens_s": round(sum(done) / wall, 2),
+        "p50_ms": st1["p50_ms"],
+        "p99_ms": st1["p99_ms"],
+        "ttft_p50_ms": st1["ttft_p50_ms"],
+        "generations": len(done),
+        "shed": st1["shed"] - st0["shed"],
+        "shed_tenant_quota": (st1["shed_tenant_quota"]
+                              - st0["shed_tenant_quota"]),
+        "shed_by_class": {c: sheds.count(c) for c in sorted(set(sheds))},
+        "adapter_acquires": hits + misses,
+        "adapter_hit_rate": round(hits / (hits + misses), 4)
+        if hits + misses else None,
+        "adapter_evictions": (ad1.get("evictions", 0)
+                              - ad0.get("evictions", 0)),
+        "tenants": {t: dict(d)
+                    for t, d in sorted(st1.get("tenants", {}).items())},
+    }
+    return out
+
+
+def main_decode_lora():
+    import mxnet_tpu as mx
+    from mxnet_tpu.adapters import AdapterPool, TenantQuota
+
+    backend = jax.default_backend()
+    cpu = backend == "cpu"
+    cfg = build_decode_config(cpu)
+    adapters_sweep = _csv_ints(os.environ.get("LORA_ADAPTERS", "1,4,8"))
+    clients = int(os.environ.get("LORA_CLIENTS", "4" if cpu else "16"))
+    per_client = int(os.environ.get("LORA_REQUESTS",
+                                    "4" if cpu else "12"))
+    slots = int(os.environ.get("LORA_SLOTS", str(max(4, clients))))
+    pmin, pmax = _csv_ints(os.environ.get("LORA_PROMPT",
+                                          "8,32" if cpu else "16,96"))
+    nmin, nmax = _csv_ints(os.environ.get("LORA_NEW",
+                                          "16,32" if cpu else "32,96"))
+    log(f"lora backend={backend} cfg={cfg} adapters={adapters_sweep} "
+        f"clients={clients} slots={slots} "
+        f"prompt=U[{pmin},{pmax}] new=U[{nmin},{nmax}]")
+
+    t0 = time.perf_counter()
+    params = build_lm_params(cfg)
+    log(f"model built in {time.perf_counter() - t0:.1f}s")
+    kw = dict(vocab_size=cfg["vocab_size"], num_layers=cfg["num_layers"],
+              num_heads=cfg["num_heads"], d_model=cfg["d_model"],
+              max_len=cfg["max_len"], kv_block=cfg["kv_block"],
+              max_streams=clients, temperature=0.0, prewarm=True)
+
+    def mk_plain(rng):
+        p = rng.randint(pmin, pmax + 1)
+        n = rng.randint(nmin, nmax + 1)
+        return (rng.randint(1, cfg["vocab_size"], size=p)
+                .astype(np.int32), n, {})
+
+    # single-tenant reference: NO adapter pool -> no LoRA epilogue in
+    # the compiled decode step at all
+    eng = mx.DecodeEngine(params, **kw)
+    try:
+        plain = bench_lora_point(eng, mk_plain, clients, per_client)
+    finally:
+        eng.close()
+    log(f"single-tenant reference: {plain['tokens_s']:.1f} tok/s, "
+        f"p50 {plain['p50_ms']:.1f} ms")
+
+    # ranks 5 and 8 both pad into the r8 bucket, so every adapter
+    # contends for the SAME `slots` rows — the widest sweep point
+    # (default 8 adapters over 4 slots) forces real LRU paging
+    rank_buckets = (4, 8)
+    pool = AdapterPool(num_layers=cfg["num_layers"],
+                       d_model=cfg["d_model"], slots=slots,
+                       rank_buckets=rank_buckets)
+    n_max = max(adapters_sweep)
+    wrng = np.random.RandomState(42)
+    for j in range(n_max):
+        r = 8 if j % 2 else 5
+        pool.publish(
+            f"ad{j}",
+            (wrng.randn(cfg["num_layers"], cfg["d_model"], r)
+             * 0.05).astype(np.float32),
+            (wrng.randn(cfg["num_layers"], r, 3 * cfg["d_model"])
+             * 0.05).astype(np.float32))
+
+    def mk_mixed(n_adapters):
+        def mk(rng):
+            prompt, n, _ = mk_plain(rng)
+            kw2 = {"slo_class": "interactive"
+                   if rng.rand() < 0.7 else "batch"}
+            if n_adapters and rng.rand() < 0.8:
+                j = rng.randint(n_adapters)
+                kw2.update(adapter=f"ad{j}", tenant=f"tn{j}")
+            else:
+                kw2.update(tenant="tn-plain")
+            return prompt, n, kw2
+        return mk
+
+    eng = mx.DecodeEngine(params, adapters=pool, **kw)
+    try:
+        sweep = []
+        for n_ad in [0] + adapters_sweep:
+            pt = bench_lora_point(eng, mk_mixed(n_ad), clients,
+                                  per_client)
+            pt["adapters"] = n_ad
+            pt["vs_single_tenant"] = round(
+                pt["tokens_s"] / plain["tokens_s"], 3)
+            sweep.append(pt)
+            hr = pt["adapter_hit_rate"]
+            log(f"{n_ad:2d} adapters -> {pt['tokens_s']:8.1f} tok/s "
+                f"(x{pt['vs_single_tenant']:.2f} single-tenant), "
+                f"p50 {pt['p50_ms']:.1f} ms, hit rate "
+                f"{'-' if hr is None else f'{hr:.0%}'}, "
+                f"evictions {pt['adapter_evictions']}, "
+                f"shed {pt['shed']}")
+        pool_stats = eng.stats().get("adapters", {})
+    finally:
+        eng.close()
+
+    # typed quota shed on a hard budget (refill 0): first requests
+    # admit, the over-budget tail sheds before any decode step
+    quota_cap = int(os.environ.get("LORA_QUOTA_TOKENS", "64"))
+    qeng = mx.DecodeEngine(
+        params, adapters=pool,
+        tenant_quota=TenantQuota(quota_cap, refill_rate=0.0),
+        **{**kw, "prewarm": False, "max_streams": 2})
+
+    def mk_quota(rng):
+        prompt, _, _ = mk_plain(rng)
+        return prompt[:8], 16, {"tenant": "tn0", "adapter": "ad0",
+                                "slo_class": "batch"}
+
+    try:
+        qpt = bench_lora_point(qeng, mk_quota, 1, 8)
+        qstats = qeng.stats()
+    finally:
+        qeng.close()
+    log(f"quota demo (cap {quota_cap} tokens): "
+        f"{qpt['generations']} admitted, "
+        f"{qpt['shed_tenant_quota']} shed typed")
+
+    mixed = [p for p in sweep if p["adapters"] > 0]
+    widest = max(mixed, key=lambda p: p["adapters"])
+    print(json.dumps({
+        "metric": "serving_lora_multitenancy",
+        "value": widest["tokens_s"],
+        "unit": "tokens/s",
+        "backend": backend,
+        "model": "transformer_lm",
+        "config": cfg,
+        "clients": clients,
+        "adapter_slots": slots,
+        "rank_buckets": list(rank_buckets),
+        "tokens_s": widest["tokens_s"],
+        "adapters_mixed": widest["adapters"],
+        "vs_single_tenant": widest["vs_single_tenant"],
+        "lora_epilogue_overhead": round(
+            sweep[0]["tokens_s"] / plain["tokens_s"], 3),
+        "adapter_hit_rate": widest["adapter_hit_rate"],
+        "adapter_evictions": sum(p["adapter_evictions"] for p in sweep),
+        "shed": sum(p["shed"] for p in sweep),
+        "single_tenant_tokens_s": plain["tokens_s"],
+        "pool": pool_stats,
+        "quota_demo": {
+            "capacity_tokens": quota_cap,
+            "admitted": qpt["generations"],
+            "shed_tenant_quota": qpt["shed_tenant_quota"],
+            "shed_by_class": qpt["shed_by_class"],
+            "tenants": qstats.get("tenants", {}),
+        },
+        "sweep": sweep,
+    }))
+
+
+# ---------------------------------------------------------------------------
 # --decode --shared-prefix: the prefix-cache acceptance workload.
 #
 # Methodology (PERF.md appendix "Prefix caching"):
@@ -715,14 +955,35 @@ def main_decode_spec():
                                           "12,32" if cpu else "32,128"))
     spec_k = int(os.environ.get("DECODE_SPEC_TOKENS", "4"))
     epochs = int(os.environ.get("DECODE_TRAIN_EPOCHS", "6"))
+    proposer_name = os.environ.get("DECODE_PROPOSER", "ngram")
     log(f"spec decode backend={backend} cfg={cfg} clients={clients} "
-        f"k={spec_k} train_epochs={epochs}")
+        f"k={spec_k} train_epochs={epochs} proposer={proposer_name}")
     t0 = time.perf_counter()
     if epochs > 0:
         params = train_copy_lm(cfg, epochs)
         log(f"copy-trained LM in {time.perf_counter() - t0:.0f}s")
     else:
         params = build_lm_params(cfg)
+
+    proposer = None
+    dcfg = None
+    if proposer_name == "draft_lm":
+        # the Leviathan setup: a SMALLER LM trained on the same
+        # distribution drafts for the big one (vs the n-gram
+        # self-drafter, which can only replay the stream's history).
+        # Depth stays 2 (induction needs two attention layers; 1L
+        # measured 35% acceptance vs 2L's 38%); width shrinks to a
+        # quarter of the target's.
+        from mxnet_tpu.speculative import DraftLMProposer
+        dcfg = dict(cfg, d_model=64)
+        t0 = time.perf_counter()
+        dparams = train_copy_lm(dcfg, epochs) if epochs > 0 \
+            else build_lm_params(dcfg)
+        log(f"draft LM ({dcfg['num_layers']}L d{dcfg['d_model']}) "
+            f"ready in {time.perf_counter() - t0:.0f}s")
+        proposer = DraftLMProposer(dparams,
+                                   num_heads=dcfg["num_heads"],
+                                   kv_block=cfg["kv_block"])
 
     def mk_request(rng):
         # repetitive prompt: a per-request motif tiled to the length —
@@ -740,7 +1001,8 @@ def main_decode_spec():
             num_layers=cfg["num_layers"], num_heads=cfg["num_heads"],
             d_model=cfg["d_model"], max_len=cfg["max_len"],
             kv_block=cfg["kv_block"], max_streams=clients,
-            temperature=0.0, spec_tokens=k, prewarm=True)
+            temperature=0.0, spec_tokens=k,
+            proposer=proposer if k else None, prewarm=True)
         try:
             return bench_decode_point(eng, mk_request, clients,
                                       per_client)
@@ -761,7 +1023,10 @@ def main_decode_spec():
         f"({time.perf_counter() - t0:.0f}s)")
     n_dev = max(1, jax.local_device_count())
     print(json.dumps({
-        "metric": "serving_speculative_decode",
+        # draft_lm records under its own metric name so the n-gram
+        # baseline history keeps a single-proposer noise model
+        "metric": "serving_speculative_decode"
+        + ("" if proposer_name == "ngram" else f"_{proposer_name}"),
         "value": round(pt["tokens_s"] / max(base["tokens_s"], 1e-9), 3),
         "unit": "x tokens/s vs non-speculative",
         "backend": backend,
@@ -769,7 +1034,8 @@ def main_decode_spec():
         "config": cfg,
         "clients": clients,
         "spec_tokens": spec_k,
-        "proposer": "ngram",
+        "proposer": proposer_name,
+        "draft_config": dcfg,
         "accepted_token_rate": pt["accepted_token_rate"],
         "tokens_per_step": pt["tokens_per_step"],
         "spec_steps": pt["spec_steps"],
@@ -1112,7 +1378,9 @@ def main():
 
 
 if __name__ == "__main__":
-    if "--decode" in sys.argv and "--shared-prefix" in sys.argv:
+    if "--decode" in sys.argv and "--lora" in sys.argv:
+        main_decode_lora()
+    elif "--decode" in sys.argv and "--shared-prefix" in sys.argv:
         main_decode_shared()
     elif "--decode" in sys.argv and "--spec" in sys.argv:
         main_decode_spec()

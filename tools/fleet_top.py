@@ -10,7 +10,10 @@ without attaching a debugger to any process.  When some process
 exports an ``slo`` statusz section the table grows the SLO columns —
 worst-burning class/metric, fast-window burn rate (``!`` = alert
 active), budget remaining, canary p50, attributed FLOP rate — and
-keeps the classic layout for fleets without an SLO config.
+keeps the classic layout for fleets without an SLO config.  The same
+gating grows the multi-tenancy columns (tenant count, busiest tenant
+by generated-token share, typed quota sheds) only when some replica
+exports a non-empty ``tenants`` section.
 
 Endpoints come from either:
 
@@ -105,10 +108,31 @@ def _slo_cells(doc: Dict) -> List[str]:
     ]
 
 
+def _tenant_cells(doc: Dict) -> List[str]:
+    """The multi-tenancy columns for one process: tenant count, the
+    busiest tenant by generated-token share, and typed quota sheds.
+    A replica without tenant traffic renders dashes — the fairness
+    counters only exist once tagged requests arrive."""
+    eng = doc.get("engine") or {}
+    tenants = eng.get("tenants") or {}
+    if not tenants:
+        return ["-", "-", _fmt(eng.get("shed_tenant_quota"))]
+    toks = {t: d.get("tokens", 0) for t, d in tenants.items()}
+    total = sum(toks.values())
+    top = max(sorted(toks), key=lambda t: toks[t])
+    share = f":{toks[top] / total:.0%}" if total else ""
+    return [
+        _fmt(len(tenants)),
+        f"{top}{share}",
+        _fmt(eng.get("shed_tenant_quota")),
+    ]
+
+
 def rows(docs: List[Tuple[str, str, Optional[Dict]]],
-         slo_on: bool = False, role_on: bool = False) -> List[List[str]]:
+         slo_on: bool = False, role_on: bool = False,
+         tenant_on: bool = False) -> List[List[str]]:
     out = []
-    ncols = len(header(slo_on, role_on))
+    ncols = len(header(slo_on, role_on, tenant_on))
     for label, ep, doc in docs:
         if doc is None:
             out.append([label, ep, "DOWN"] + ["-"] * (ncols - 3))
@@ -141,6 +165,8 @@ def rows(docs: List[Tuple[str, str, Optional[Dict]]],
             # rate (pages shipped out + spliced in, per second)
             row.append(_fmt(eng.get("role")))
             row.append(_fmt(eng.get("migrations_per_s"), ".1f"))
+        if tenant_on:
+            row.extend(_tenant_cells(doc))
         if slo_on:
             row.extend(_slo_cells(doc))
         out.append(row)
@@ -150,21 +176,27 @@ def rows(docs: List[Tuple[str, str, Optional[Dict]]],
 _HEADER = ["ID", "ENDPOINT", "PID", "KIND", "INFL", "ACTIVE", "CACHE",
            "RATE", "P99MS", "WSTEP", "EPOCH", "GOODPUT/MFU"]
 _ROLE_HEADER = ["ROLE", "MIG/S"]
+_TENANT_HEADER = ["TEN", "TOPTENANT", "QSHED"]
 _SLO_HEADER = ["SLO", "BURN", "BUDGET", "CANP50", "FLOP/S"]
 
 
-def header(slo_on: bool = False, role_on: bool = False) -> List[str]:
+def header(slo_on: bool = False, role_on: bool = False,
+           tenant_on: bool = False) -> List[str]:
     """Fleets without an SLO config keep the classic 12-column
     layout; the SLO columns appear only when some process exports a
-    ``slo`` statusz section, and the disaggregation columns
-    (ROLE, MIG/S) only when some replica exports a role."""
+    ``slo`` statusz section, the disaggregation columns (ROLE, MIG/S)
+    only when some replica exports a role, and the tenancy columns
+    (TEN, TOPTENANT, QSHED) only when some replica exports a
+    non-empty ``tenants`` fairness table."""
     head = _HEADER + _ROLE_HEADER if role_on else list(_HEADER)
+    if tenant_on:
+        head = head + _TENANT_HEADER
     return head + _SLO_HEADER if slo_on else head
 
 
 def render(table: List[List[str]], slo_on: bool = False,
-           role_on: bool = False) -> str:
-    head = header(slo_on, role_on)
+           role_on: bool = False, tenant_on: bool = False) -> str:
+    head = header(slo_on, role_on, tenant_on)
     widths = [max(len(str(r[i])) for r in [head] + table)
               for i in range(len(head))]
     lines = ["  ".join(h.ljust(w) for h, w in zip(head, widths))]
@@ -206,7 +238,11 @@ def main(argv=None) -> int:
             role_on = any(d is not None
                           and (d.get("engine") or {}).get("role")
                           for _, _, d in docs)
-            print(render(rows(docs, slo_on, role_on), slo_on, role_on))
+            tenant_on = any(d is not None
+                            and (d.get("engine") or {}).get("tenants")
+                            for _, _, d in docs)
+            print(render(rows(docs, slo_on, role_on, tenant_on),
+                         slo_on, role_on, tenant_on))
         if not args.watch:
             return 0 if docs and any(d for _, _, d in docs) else 1
         time.sleep(args.watch)
